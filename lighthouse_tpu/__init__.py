@@ -1,0 +1,20 @@
+"""lighthouse_tpu: a TPU-native Ethereum consensus (beacon chain) framework.
+
+A from-scratch re-design of the capabilities of the Lighthouse consensus client
+(reference: jimmygchen/lighthouse) for TPU hardware: the batch-heavy work —
+BLS12-381 batch signature verification, SSZ Merkleization, KZG blob proofs —
+runs on device via JAX/XLA (Pallas where it pays), while spec logic, fork
+choice, storage and networking live on the host.
+
+Layering (mirrors reference layer map, SURVEY.md §1):
+  utils/ ops/ parallel/   – hashing, device kernels, mesh/sharding helpers
+  ssz/                    – SSZ serialization + Merkleization (ethereum_ssz, tree_hash)
+  crypto/                 – BLS12-381 + KZG (crypto/bls, crypto/kzg)
+  types/                  – consensus containers, EthSpec/ChainSpec (consensus/types)
+  state_processing/       – state transition (consensus/state_processing)
+  fork_choice/            – proto-array fork choice (consensus/{fork_choice,proto_array})
+  store/                  – hot/cold storage (beacon_node/store)
+  beacon_chain/           – chain orchestration (beacon_node/beacon_chain)
+"""
+
+__version__ = "0.1.0"

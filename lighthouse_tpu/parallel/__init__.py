@@ -1,0 +1,1 @@
+from .mesh import batch_mesh, shard_batch

@@ -1,0 +1,40 @@
+"""Device mesh / sharding helpers.
+
+The reference scales its hot loops with rayon thread pools
+(state_processing/src/per_block_processing/block_signature_verifier.rs:396-404)
+and NCCL-free multi-process libp2p. The TPU-native analog: one logical `batch`
+mesh axis over all chips; crypto/hash batches are sharded along it and reduced
+with XLA collectives over ICI. The p2p stack stays on host (SURVEY.md §2.10).
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_mesh(devices=None, axis: str = "batch") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+def shard_batch(mesh: Mesh, axis: str = "batch") -> NamedSharding:
+    """Sharding that splits the leading (batch) dimension across the mesh."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def bucket_size(n: int, minimum: int = 16) -> int:
+    """Round a dynamic batch size up to a power-of-two bucket so jit caches a
+    small number of compiled shapes (reference batches gossip work in fixed
+    chunks of 64 for the same reason, beacon_processor/src/lib.rs:200)."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size

@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The image's sitecustomize eagerly registers the axon TPU backend and pins
+JAX_PLATFORMS=axon, so we must override via jax.config after import. Multi-chip
+TPU hardware isn't available in CI; sharding correctness is validated on a
+virtual host-platform mesh exactly as the driver's dryrun_multichip does (see
+__graft_entry__.py).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# Persistent compile cache: this XLA CPU build compiles slowly; cache across runs.
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

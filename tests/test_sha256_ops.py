@@ -1,0 +1,38 @@
+"""Device SHA-256 kernel vs hashlib."""
+
+import hashlib
+
+import numpy as np
+
+from lighthouse_tpu.ops.sha256 import (
+    bytes_to_words,
+    merkleize_device,
+    sha256_pairs,
+    words_to_bytes,
+)
+from lighthouse_tpu.utils.hash import ZERO_HASHES, hash32_concat
+
+
+def test_sha256_pairs_matches_hashlib():
+    rng = np.random.default_rng(0)
+    msgs = [rng.integers(0, 256, 64, dtype=np.uint8).tobytes() for _ in range(33)]
+    blocks = bytes_to_words(b"".join(msgs)).reshape(-1, 16)
+    out = np.asarray(sha256_pairs(blocks))
+    for i, m in enumerate(msgs):
+        assert words_to_bytes(out[i]) == hashlib.sha256(m).digest()
+
+
+def test_zero_hashes_on_device():
+    leaves = np.zeros((8, 8), dtype=np.uint32)
+    root = words_to_bytes(merkleize_device(leaves))
+    assert root == ZERO_HASHES[3]
+
+
+def test_merkleize_device_matches_host():
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, 32 * 16, dtype=np.uint8).tobytes()
+    nodes = [data[i : i + 32] for i in range(0, len(data), 32)]
+    while len(nodes) > 1:
+        nodes = [hash32_concat(nodes[i], nodes[i + 1]) for i in range(0, len(nodes), 2)]
+    got = words_to_bytes(merkleize_device(bytes_to_words(data)))
+    assert got == nodes[0]
