@@ -227,7 +227,8 @@ class ByteVector(SSZType):
 
     @classmethod
     def serialize_value(cls, value) -> bytes:
-        assert len(value) == cls.LENGTH, (len(value), cls.LENGTH)
+        if len(value) != cls.LENGTH:
+            raise ValueError(f"ByteVector[{cls.LENGTH}]: got {len(value)} bytes")
         return bytes(value)
 
     @classmethod
@@ -278,7 +279,8 @@ class ByteList(SSZType):
 
     @classmethod
     def serialize_value(cls, value) -> bytes:
-        assert len(value) <= cls.LIMIT
+        if len(value) > cls.LIMIT:
+            raise ValueError(f"ByteList[{cls.LIMIT}]: got {len(value)} bytes")
         return bytes(value)
 
     @classmethod
@@ -395,7 +397,8 @@ class Vector(SSZType):
 
     @classmethod
     def serialize_value(cls, value) -> bytes:
-        assert len(value) == cls.LENGTH
+        if len(value) != cls.LENGTH:
+            raise ValueError(f"Vector length {len(value)} != {cls.LENGTH}")
         return _serialize_homogeneous(cls.ELEM, value)
 
     @classmethod
@@ -447,7 +450,8 @@ class List(SSZType):
 
     @classmethod
     def serialize_value(cls, value) -> bytes:
-        assert len(value) <= cls.LIMIT
+        if len(value) > cls.LIMIT:
+            raise ValueError(f"List limit {cls.LIMIT} exceeded: {len(value)}")
         return _serialize_homogeneous(cls.ELEM, value)
 
     @classmethod
@@ -517,7 +521,8 @@ class Bitvector(SSZType):
 
     @classmethod
     def serialize_value(cls, value) -> bytes:
-        assert len(value) == cls.LENGTH
+        if len(value) != cls.LENGTH:
+            raise ValueError(f"Bitvector length {len(value)} != {cls.LENGTH}")
         return _bits_to_bytes(value)
 
     @classmethod
@@ -564,7 +569,8 @@ class Bitlist(SSZType):
 
     @classmethod
     def serialize_value(cls, value) -> bytes:
-        assert len(value) <= cls.LIMIT
+        if len(value) > cls.LIMIT:
+            raise ValueError(f"Bitlist limit {cls.LIMIT} exceeded: {len(value)}")
         # Delimiter bit marks the length.
         data = bytearray(_bits_to_bytes(list(value) + [True]))
         return bytes(data)

@@ -1,0 +1,103 @@
+"""Tests for the consensus types layer (presets, ChainSpec, containers)."""
+
+from lighthouse_tpu.types import (
+    ChainSpec,
+    Domain,
+    ForkName,
+    MainnetEthSpec,
+    MinimalEthSpec,
+    build_types,
+    compute_signing_root,
+    mainnet_spec,
+    minimal_spec,
+    spec_with_forks_at_genesis,
+)
+
+
+def test_presets():
+    assert MainnetEthSpec.SLOTS_PER_EPOCH == 32
+    assert MinimalEthSpec.SLOTS_PER_EPOCH == 8
+    assert MainnetEthSpec.slots_per_eth1_voting_period() == 2048
+    assert MinimalEthSpec.slots_per_eth1_voting_period() == 32
+    assert MainnetEthSpec.SYNC_COMMITTEE_SIZE == 512
+    assert MinimalEthSpec.SYNC_COMMITTEE_SIZE == 32
+
+
+def test_fork_schedule_mainnet():
+    spec = mainnet_spec()
+    assert spec.fork_name_at_epoch(0) == ForkName.PHASE0
+    assert spec.fork_name_at_epoch(74240) == ForkName.ALTAIR
+    assert spec.fork_name_at_epoch(144896) == ForkName.BELLATRIX
+    assert spec.fork_name_at_epoch(194048) == ForkName.CAPELLA
+    assert spec.fork_name_at_epoch(269568) == ForkName.DENEB
+    assert ForkName.DENEB > ForkName.CAPELLA >= ForkName.CAPELLA
+
+
+def test_fork_data_root_zero():
+    # hash(bytes32(0) || bytes32(0)) — the canonical zero Merkle node.
+    root = ChainSpec.compute_fork_data_root(b"\x00" * 4, b"\x00" * 32)
+    assert root.hex() == (
+        "f5a5fd42d16a20302798ef6ed309979b43003d2320d9f0e8ea9831a92759fb4b"
+    )
+
+
+def test_domain_layout():
+    spec = mainnet_spec()
+    t = build_types(MainnetEthSpec)
+    fork = t.Fork(previous_version=b"\x00" * 4, current_version=b"\x01\x00\x00\x00", epoch=10)
+    d_before = spec.get_domain(5, Domain.BEACON_ATTESTER, fork, b"\x11" * 32)
+    d_after = spec.get_domain(10, Domain.BEACON_ATTESTER, fork, b"\x11" * 32)
+    assert d_before[:4] == (1).to_bytes(4, "little")
+    assert d_after[:4] == (1).to_bytes(4, "little")
+    assert d_before[4:] != d_after[4:]  # different fork versions mix in
+
+
+def test_signing_root():
+    root = compute_signing_root(b"\xaa" * 32, b"\xbb" * 32)
+    import hashlib
+
+    assert root == hashlib.sha256(b"\xaa" * 32 + b"\xbb" * 32).digest()
+
+
+def test_state_roundtrip_all_forks():
+    t = build_types(MinimalEthSpec)
+    for fork, ns in t.forks.items():
+        state = ns.BeaconState()
+        data = state.serialize()
+        state2 = ns.BeaconState.deserialize(data)
+        assert state2.hash_tree_root() == state.hash_tree_root(), fork
+        assert t.fork_of_state(state) == fork
+
+        block = ns.SignedBeaconBlock()
+        data = block.serialize()
+        block2 = ns.SignedBeaconBlock.deserialize(data)
+        assert block2.hash_tree_root() == block.hash_tree_root(), fork
+
+
+def test_state_field_mutation_and_copy():
+    t = build_types(MinimalEthSpec)
+    s = t.BeaconState()
+    s.slot = 5
+    s.validators = [t.Validator(effective_balance=32 * 10**9)]
+    s.balances = [32 * 10**9]
+    c = s.copy()
+    c.slot = 6
+    c.balances[0] = 1
+    assert s.slot == 5 and s.balances[0] == 32 * 10**9
+    assert c.validators[0].effective_balance == 32 * 10**9
+    # copy must not share mutable validator objects
+    c.validators[0].slashed = True
+    assert not s.validators[0].slashed
+
+
+def test_forks_at_genesis_helper():
+    spec = spec_with_forks_at_genesis(minimal_spec(), ForkName.CAPELLA)
+    assert spec.fork_name_at_epoch(0) == ForkName.CAPELLA
+    assert spec.deneb_fork_epoch is None
+
+
+def test_deneb_blob_sidecar_shape():
+    t = build_types(MainnetEthSpec)
+    sc = t.BlobSidecar()
+    assert len(sc.blob) == 131072
+    assert len(sc.kzg_commitment_inclusion_proof) == 17
