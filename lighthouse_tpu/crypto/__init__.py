@@ -1,0 +1,7 @@
+"""Cryptography layer: BLS12-381 + KZG.
+
+Capability mirror of the reference's `crypto/bls` and `crypto/kzg` crates
+(SURVEY.md §2.1). The pairing-friendly curve arithmetic lives in
+`bls12_381/` (host reference implementation, pure Python bigints); the
+batch-verification device path lives in `lighthouse_tpu.ops.bls381`.
+"""

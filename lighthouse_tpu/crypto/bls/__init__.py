@@ -1,0 +1,445 @@
+"""BLS signature layer with a pluggable backend seam.
+
+Mirrors the reference's backend-generic `crypto/bls` crate
+(crypto/bls/src/lib.rs:84-139): the same type family (SecretKey, PublicKey,
+Signature, AggregateSignature, SignatureSet) works over any backend; the
+reference selects backends at compile time via cargo features
+(blst / fake_crypto), we select at runtime via `set_backend`.
+
+Backends:
+  "host"        — pure-Python BLS12-381 (the blst analog; default)
+  "tpu"         — host ops + device-batched verify_signature_sets
+  "fake_crypto" — always-valid no-op crypto for spec-logic tests
+                  (crypto/bls/src/impls/fake_crypto.rs equivalent)
+
+The eth2 scheme is min-pubkey-size: pubkeys in G1 (48 B), signatures in G2
+(96 B), proof-of-possession ciphersuite DST (impls/blst.rs:13). Messages are
+always 32-byte signing roots (consensus/types/src/signing_data.rs:22-35).
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+from ..bls12_381 import (
+    FQ,
+    FQ2,
+    G1_GEN,
+    R,
+    g1_from_bytes,
+    g1_in_subgroup,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_in_subgroup,
+    g2_to_bytes,
+    hash_to_g2,
+    inf,
+    is_inf,
+    pairing_check,
+    pt_add,
+    pt_mul,
+    pt_neg,
+)
+
+PUBLIC_KEY_BYTES_LEN = 48
+SIGNATURE_BYTES_LEN = 96
+SECRET_KEY_BYTES_LEN = 32
+# Bits of randomness per batch-verify scalar (impls/blst.rs:14 RAND_BITS).
+RAND_BITS = 64
+
+INFINITY_PUBLIC_KEY = bytes([0xC0]) + bytes(47)
+INFINITY_SIGNATURE = bytes([0xC0]) + bytes(95)
+
+
+class BlsError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Type family (generic over backend, like the reference's define_mod! output)
+# ---------------------------------------------------------------------------
+
+
+class PublicKey:
+    """G1 point, 48-byte compressed. Decompression is lazy and cached —
+    the decompressed form is what the validator-pubkey cache keeps resident
+    (beacon_chain/src/validator_pubkey_cache.rs:17 analog)."""
+
+    __slots__ = ("_bytes", "_point")
+
+    def __init__(self, data: bytes, point=None):
+        if len(data) != PUBLIC_KEY_BYTES_LEN:
+            raise BlsError(f"pubkey must be {PUBLIC_KEY_BYTES_LEN} bytes")
+        self._bytes = bytes(data)
+        self._point = point
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "PublicKey":
+        pk = cls(data)
+        if not _backend.fake:
+            pk.point()  # force decompression => validity check
+        return pk
+
+    @classmethod
+    def from_point(cls, point) -> "PublicKey":
+        return cls(g1_to_bytes(point), point)
+
+    def to_bytes(self) -> bytes:
+        return self._bytes
+
+    def point(self):
+        if self._point is None:
+            if self._bytes == INFINITY_PUBLIC_KEY:
+                raise BlsError("pubkey is the point at infinity")
+            self._point = g1_from_bytes(self._bytes)
+        return self._point
+
+    def validate(self) -> bool:
+        """KeyValidate: decompresses, rejects infinity, checks subgroup."""
+        if _backend.fake:
+            return True
+        try:
+            return g1_in_subgroup(self.point())
+        except BlsError:
+            return False
+        except ValueError:
+            return False
+
+    def __eq__(self, other):
+        return isinstance(other, PublicKey) and self._bytes == other._bytes
+
+    def __hash__(self):
+        return hash(self._bytes)
+
+    def __repr__(self):
+        return f"PublicKey(0x{self._bytes.hex()[:16]}…)"
+
+
+class Signature:
+    """G2 point, 96-byte compressed."""
+
+    __slots__ = ("_bytes", "_point")
+
+    def __init__(self, data: bytes, point=None):
+        if len(data) != SIGNATURE_BYTES_LEN:
+            raise BlsError(f"signature must be {SIGNATURE_BYTES_LEN} bytes")
+        self._bytes = bytes(data)
+        self._point = point
+
+    empty = classmethod(lambda cls: cls(INFINITY_SIGNATURE))
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Signature":
+        return cls(data)
+
+    @classmethod
+    def from_point(cls, point) -> "Signature":
+        return cls(g2_to_bytes(point), point)
+
+    def to_bytes(self) -> bytes:
+        return self._bytes
+
+    def is_infinity(self) -> bool:
+        return self._bytes == INFINITY_SIGNATURE
+
+    def point(self):
+        if self._point is None:
+            self._point = g2_from_bytes(self._bytes)
+        return self._point
+
+    def verify(self, pubkey: PublicKey, message: bytes) -> bool:
+        return _backend.verify(self, pubkey, message)
+
+    def __eq__(self, other):
+        return isinstance(other, Signature) and self._bytes == other._bytes
+
+    def __hash__(self):
+        return hash(self._bytes)
+
+    def __repr__(self):
+        return f"Signature(0x{self._bytes.hex()[:16]}…)"
+
+
+class SecretKey:
+    """Scalar in [1, r). Never leaves the host (SURVEY.md §7 step 2)."""
+
+    __slots__ = ("_scalar",)
+
+    def __init__(self, scalar: int):
+        if not 1 <= scalar < R:
+            raise BlsError("secret key out of range")
+        self._scalar = scalar
+
+    @classmethod
+    def random(cls) -> "SecretKey":
+        return cls(secrets.randbelow(R - 1) + 1)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SecretKey":
+        if len(data) != SECRET_KEY_BYTES_LEN:
+            raise BlsError("secret key must be 32 bytes")
+        return cls(int.from_bytes(data, "big"))
+
+    def to_bytes(self) -> bytes:
+        return self._scalar.to_bytes(32, "big")
+
+    @property
+    def scalar(self) -> int:
+        return self._scalar
+
+    def public_key(self) -> PublicKey:
+        if _backend.fake:
+            return PublicKey(_fake_pubkey_bytes(self._scalar))
+        return PublicKey.from_point(pt_mul(FQ, G1_GEN, self._scalar))
+
+    def sign(self, message: bytes) -> Signature:
+        return _backend.sign(self, message)
+
+
+@dataclass
+class Keypair:
+    sk: SecretKey
+    pk: PublicKey
+
+    @classmethod
+    def random(cls) -> "Keypair":
+        sk = SecretKey.random()
+        return cls(sk=sk, pk=sk.public_key())
+
+
+class AggregateSignature:
+    """Running aggregate of G2 signatures
+    (generic_aggregate_signature.rs equivalent)."""
+
+    __slots__ = ("_point", "_empty")
+
+    def __init__(self):
+        self._point = inf(FQ2)
+        self._empty = True
+
+    @classmethod
+    def from_signatures(cls, sigs) -> "AggregateSignature":
+        agg = cls()
+        for s in sigs:
+            agg.add_assign(s)
+        return agg
+
+    def add_assign(self, sig: Signature):
+        if _backend.fake:
+            self._empty = False
+            return
+        self._point = pt_add(FQ2, self._point, sig.point())
+        self._empty = False
+
+    def to_signature(self) -> Signature:
+        if _backend.fake:
+            return Signature(INFINITY_SIGNATURE)
+        if self._empty:
+            return Signature(INFINITY_SIGNATURE)
+        return Signature.from_point(self._point)
+
+    def fast_aggregate_verify(self, pubkeys, message: bytes) -> bool:
+        return self.to_signature().verify(aggregate_pubkeys(pubkeys), message)
+
+
+def aggregate_pubkeys(pubkeys) -> PublicKey:
+    if _backend.fake:
+        return pubkeys[0] if pubkeys else PublicKey(INFINITY_PUBLIC_KEY)
+    acc = inf(FQ)
+    for pk in pubkeys:
+        acc = pt_add(FQ, acc, pk.point())
+    return PublicKey.from_point(acc)
+
+
+@dataclass
+class SignatureSet:
+    """(signature, pubkeys-to-aggregate, 32-byte message) triple — one unit
+    of batch verification (crypto/bls/src/generic_signature_set.rs:61-121)."""
+
+    signature: Signature
+    pubkeys: list
+    message: bytes
+
+    @classmethod
+    def single(cls, signature, pubkey, message) -> "SignatureSet":
+        return cls(signature=signature, pubkeys=[pubkey], message=message)
+
+    def verify(self) -> bool:
+        return self.signature.verify(aggregate_pubkeys(self.pubkeys), self.message)
+
+
+# ---------------------------------------------------------------------------
+# Backends
+# ---------------------------------------------------------------------------
+
+
+class _HostBackend:
+    """Pure-Python BLS12-381 (the blst-analog production path)."""
+
+    name = "host"
+    fake = False
+
+    def sign(self, sk: SecretKey, message: bytes) -> Signature:
+        h = hash_to_g2(message)
+        return Signature.from_point(pt_mul(FQ2, h, sk.scalar))
+
+    def verify(self, sig: Signature, pubkey: PublicKey, message: bytes) -> bool:
+        try:
+            if sig.is_infinity():
+                return False
+            sig_pt = sig.point()
+            pk_pt = pubkey.point()
+        except (BlsError, ValueError):
+            return False
+        if not g2_in_subgroup(sig_pt) or not g1_in_subgroup(pk_pt):
+            return False
+        if is_inf(FQ, pk_pt):
+            return False
+        h = hash_to_g2(message)
+        # e(pk, H(m)) · e(-g1, sig) == 1
+        return pairing_check([(pk_pt, h), (pt_neg(FQ, G1_GEN), sig_pt)])
+
+    def verify_signature_sets(self, sets, rng=None) -> bool:
+        """Random-linear-combination batch verification
+        (crypto/bls/src/impls/blst.rs:35-117):
+        e(-g1, Σ rᵢ·sigᵢ) · ∏_m e(Σ_{i: mᵢ=m} rᵢ·aggpkᵢ, H(m)) == 1.
+        Same-message sets share one pairing (attestation batches are mostly
+        one message per committee)."""
+        sets = list(sets)
+        if not sets:
+            return False
+        rand = rng if rng is not None else secrets.SystemRandom()
+        agg_sig = inf(FQ2)
+        by_message: dict[bytes, object] = {}
+        for s in sets:
+            try:
+                if s.signature.is_infinity():
+                    return False
+                sig_pt = s.signature.point()
+                if not g2_in_subgroup(sig_pt):
+                    return False
+                pk_pts = [pk.point() for pk in s.pubkeys]
+            except (BlsError, ValueError):
+                return False
+            if not pk_pts:
+                return False
+            r = 0
+            while r == 0:
+                r = rand.getrandbits(RAND_BITS)
+            agg_sig = pt_add(FQ2, agg_sig, pt_mul(FQ2, sig_pt, r))
+            agg_pk = inf(FQ)
+            for p in pk_pts:
+                agg_pk = pt_add(FQ, agg_pk, p)
+            scaled = pt_mul(FQ, agg_pk, r)
+            prev = by_message.get(s.message)
+            by_message[s.message] = (
+                scaled if prev is None else pt_add(FQ, prev, scaled)
+            )
+        pairs = [(pt_neg(FQ, G1_GEN), agg_sig)]
+        for message, pk_pt in by_message.items():
+            pairs.append((pk_pt, hash_to_g2(message)))
+        return pairing_check(pairs)
+
+
+def _fake_pubkey_bytes(scalar: int) -> bytes:
+    import hashlib
+
+    d = hashlib.sha256(b"fake_pk" + scalar.to_bytes(32, "big")).digest()
+    return bytes([0xAA]) + d + d[:15]
+
+
+class _FakeBackend:
+    """fake_crypto: deterministic dummy bytes, verification always succeeds
+    (crypto/bls/src/impls/fake_crypto.rs equivalent — lets spec-logic tests
+    run without pairing cost)."""
+
+    name = "fake_crypto"
+    fake = True
+
+    def sign(self, sk: SecretKey, message: bytes) -> Signature:
+        import hashlib
+
+        d = hashlib.sha256(
+            b"fake_sig" + sk.scalar.to_bytes(32, "big") + message
+        ).digest()
+        return Signature(d + d + d)
+
+    def verify(self, sig, pubkey, message) -> bool:
+        return True
+
+    def verify_signature_sets(self, sets, rng=None) -> bool:
+        return True
+
+
+class _TpuBackend(_HostBackend):
+    """Host ops with device-batched batch verification (ops/bls381).
+
+    The RLC scalar multiplications (the MSM over signature sets) run on
+    device; the final multi-pairing runs on host until the pairing kernel
+    lands. Falls back to host behavior transparently."""
+
+    name = "tpu"
+
+    def verify_signature_sets(self, sets, rng=None) -> bool:
+        try:
+            from ...ops import bls381 as device
+        except Exception:
+            device = None
+        if device is None or not getattr(device, "AVAILABLE", False):
+            return super().verify_signature_sets(sets, rng)
+        return device.verify_signature_sets_device(sets, rng)
+
+
+_BACKENDS = {
+    "host": _HostBackend(),
+    "fake_crypto": _FakeBackend(),
+    "tpu": _TpuBackend(),
+}
+
+_backend = _BACKENDS["host"]
+
+
+def set_backend(name: str):
+    global _backend
+    _backend = _BACKENDS[name]
+
+
+def get_backend():
+    return _backend
+
+
+def backend_name() -> str:
+    return _backend.name
+
+
+def verify_signature_sets(sets, rng=None) -> bool:
+    """Module-level entry used by state_processing's BlockSignatureVerifier
+    and the attestation batch path (the reference's bls::verify_signature_sets,
+    lib.rs / impls/blst.rs:35)."""
+    return _backend.verify_signature_sets(sets, rng)
+
+
+# ---------------------------------------------------------------------------
+# Interop keypairs (common/eth2_interop_keypairs — spec deterministic keys)
+# ---------------------------------------------------------------------------
+
+import hashlib as _hashlib
+
+
+def interop_secret_key(index: int) -> SecretKey:
+    """sk = int_le(sha256(le32(index))) % r — matches the reference's
+    eth2_interop_keypairs (validated against its specs/ golden vectors)."""
+    preimage = index.to_bytes(32, "little")
+    scalar = int.from_bytes(_hashlib.sha256(preimage).digest(), "little") % R
+    return SecretKey(scalar)
+
+
+def interop_keypairs(count: int) -> list:
+    """Deterministic validator keypairs for interop genesis
+    (genesis/src/interop.rs:31 consumers)."""
+    out = []
+    for i in range(count):
+        sk = interop_secret_key(i)
+        out.append(Keypair(sk=sk, pk=sk.public_key()))
+    return out

@@ -1,0 +1,351 @@
+"""BLS12-381 elliptic curve groups G1 (over Fq) and G2 (over Fq2).
+
+Generic Jacobian-coordinate arithmetic parameterized by a field-ops adapter,
+instantiated for Fq, Fq2 and (for the pairing's untwisted points) Fq12.
+Point compression follows the ZCash serialization rules used by the
+reference's BLS wire format (crypto/bls: 48-byte G1 / 96-byte G2 compressed).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from . import fields as F
+from .fields import P, R, X
+
+# ---------------------------------------------------------------------------
+# Field-ops adapters
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FieldOps:
+    zero: Any
+    one: Any
+    add: Callable
+    sub: Callable
+    neg: Callable
+    mul: Callable
+    sqr: Callable
+    inv: Callable
+    is_zero: Callable
+    from_int: Callable
+
+
+FQ = FieldOps(
+    zero=0,
+    one=1,
+    add=lambda a, b: (a + b) % P,
+    sub=lambda a, b: (a - b) % P,
+    neg=lambda a: -a % P,
+    mul=lambda a, b: a * b % P,
+    sqr=lambda a: a * a % P,
+    inv=lambda a: pow(a, P - 2, P),
+    is_zero=lambda a: a == 0,
+    from_int=lambda n: n % P,
+)
+
+FQ2 = FieldOps(
+    zero=F.F2_ZERO,
+    one=F.F2_ONE,
+    add=F.f2_add,
+    sub=F.f2_sub,
+    neg=F.f2_neg,
+    mul=F.f2_mul,
+    sqr=F.f2_sqr,
+    inv=F.f2_inv,
+    is_zero=F.f2_is_zero,
+    from_int=lambda n: (n % P, 0),
+)
+
+FQ12 = FieldOps(
+    zero=F.F12_ZERO,
+    one=F.F12_ONE,
+    add=F.f12_add,
+    sub=F.f12_sub,
+    neg=F.f12_neg,
+    mul=F.f12_mul,
+    sqr=F.f12_sqr,
+    inv=F.f12_inv,
+    is_zero=lambda a: a == F.F12_ZERO,
+    from_int=lambda n: (((n % P, 0), F.F2_ZERO, F.F2_ZERO), F.F6_ZERO),
+)
+
+# ---------------------------------------------------------------------------
+# Generic Jacobian point arithmetic
+# ---------------------------------------------------------------------------
+# A point is (X, Y, Z) in Jacobian coordinates: affine (X/Z², Y/Z³);
+# infinity has Z = 0.
+
+
+def inf(k: FieldOps):
+    return (k.one, k.one, k.zero)
+
+
+def is_inf(k: FieldOps, pt) -> bool:
+    return k.is_zero(pt[2])
+
+
+def to_affine(k: FieldOps, pt):
+    """Returns (x, y) or None for infinity."""
+    x, y, z = pt
+    if k.is_zero(z):
+        return None
+    zi = k.inv(z)
+    zi2 = k.sqr(zi)
+    return (k.mul(x, zi2), k.mul(y, k.mul(zi2, zi)))
+
+
+def from_affine(k: FieldOps, aff):
+    if aff is None:
+        return inf(k)
+    return (aff[0], aff[1], k.one)
+
+
+def pt_neg(k: FieldOps, pt):
+    return (pt[0], k.neg(pt[1]), pt[2])
+
+
+def pt_double(k: FieldOps, pt):
+    x, y, z = pt
+    if k.is_zero(z):
+        return pt
+    a = k.sqr(x)                     # X²
+    b = k.sqr(y)                     # Y²
+    c = k.sqr(b)                     # Y⁴
+    # D = 2((X+B)² - A - C)
+    d = k.sub(k.sub(k.sqr(k.add(x, b)), a), c)
+    d = k.add(d, d)
+    e = k.add(k.add(a, a), a)        # 3X²  (curve a-coefficient is 0)
+    f2_ = k.sqr(e)
+    x3 = k.sub(f2_, k.add(d, d))
+    c8 = k.add(k.add(c, c), k.add(c, c))
+    c8 = k.add(c8, c8)
+    y3 = k.sub(k.mul(e, k.sub(d, x3)), c8)
+    z3 = k.mul(k.add(y, y), z)
+    return (x3, y3, z3)
+
+
+def pt_add(k: FieldOps, p1, p2):
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    if k.is_zero(z1):
+        return p2
+    if k.is_zero(z2):
+        return p1
+    z1z1 = k.sqr(z1)
+    z2z2 = k.sqr(z2)
+    u1 = k.mul(x1, z2z2)
+    u2 = k.mul(x2, z1z1)
+    s1 = k.mul(y1, k.mul(z2z2, z2))
+    s2 = k.mul(y2, k.mul(z1z1, z1))
+    if u1 == u2:
+        if s1 == s2:
+            return pt_double(k, p1)
+        return inf(k)
+    h = k.sub(u2, u1)
+    i = k.sqr(k.add(h, h))
+    j = k.mul(h, i)
+    r = k.sub(s2, s1)
+    r = k.add(r, r)
+    v = k.mul(u1, i)
+    x3 = k.sub(k.sub(k.sqr(r), j), k.add(v, v))
+    s1j = k.mul(s1, j)
+    y3 = k.sub(k.mul(r, k.sub(v, x3)), k.add(s1j, s1j))
+    z3 = k.mul(k.mul(z1, z2), h)
+    z3 = k.add(z3, z3)
+    # z3 = 2·z1·z2·h, consistent with the doubled r/i scaling above
+    return (x3, y3, z3)
+
+
+def pt_mul(k: FieldOps, pt, n: int):
+    """Scalar multiplication (binary double-and-add)."""
+    if n < 0:
+        return pt_mul(k, pt_neg(k, pt), -n)
+    result = inf(k)
+    addend = pt
+    while n:
+        if n & 1:
+            result = pt_add(k, result, addend)
+        addend = pt_double(k, addend)
+        n >>= 1
+    return result
+
+
+def pt_eq(k: FieldOps, p1, p2) -> bool:
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    if k.is_zero(z1) or k.is_zero(z2):
+        return k.is_zero(z1) and k.is_zero(z2)
+    z1z1 = k.sqr(z1)
+    z2z2 = k.sqr(z2)
+    if k.mul(x1, z2z2) != k.mul(x2, z1z1):
+        return False
+    return k.mul(y1, k.mul(z2z2, z2)) == k.mul(y2, k.mul(z1z1, z1))
+
+
+def is_on_curve_affine(k: FieldOps, aff, b) -> bool:
+    if aff is None:
+        return True
+    x, y = aff
+    return k.sqr(y) == k.add(k.mul(k.sqr(x), x), b)
+
+
+# ---------------------------------------------------------------------------
+# Group parameters
+# ---------------------------------------------------------------------------
+
+B1 = 4  # E1: y² = x³ + 4
+B2 = F.f2_mul_xi((4, 0))  # E2: y² = x³ + 4(u+1)  == (4, 4)
+B12 = FQ12.from_int(4)  # E over Fq12 (untwisted)
+
+# Generators (standard BLS12-381 generators; verified in tests against
+# on-curve + subgroup-order checks)
+G1_GEN = (
+    0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB,
+    0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1,
+    1,
+)
+G2_GEN = (
+    (
+        0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+        0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+    ),
+    (
+        0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+        0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+    ),
+    F.F2_ONE,
+)
+
+# Cofactors: h1 = (x-1)²/3; h2 = (x⁸-4x⁷+5x⁶-4x⁴+6x³-4x²-4x+13)/9
+H1 = (X - 1) ** 2 // 3
+H2 = (X**8 - 4 * X**7 + 5 * X**6 - 4 * X**4 + 6 * X**3 - 4 * X**2 - 4 * X + 13) // 9
+assert H1 == 0x396C8C005555E1568C00AAAB0000AAAB
+
+# ---------------------------------------------------------------------------
+# Subgroup / membership checks
+# ---------------------------------------------------------------------------
+
+
+def g1_is_on_curve(pt) -> bool:
+    return is_on_curve_affine(FQ, to_affine(FQ, pt), B1)
+
+
+def g2_is_on_curve(pt) -> bool:
+    return is_on_curve_affine(FQ2, to_affine(FQ2, pt), B2)
+
+
+def g1_in_subgroup(pt) -> bool:
+    return g1_is_on_curve(pt) and is_inf(FQ, pt_mul(FQ, pt, R))
+
+
+def g2_in_subgroup(pt) -> bool:
+    return g2_is_on_curve(pt) and is_inf(FQ2, pt_mul(FQ2, pt, R))
+
+
+# ---------------------------------------------------------------------------
+# ZCash-format point serialization
+# (flags in the 3 top bits of the first byte: compressed, infinity, y-sign)
+# ---------------------------------------------------------------------------
+
+_COMPRESSED = 1 << 7
+_INFINITY = 1 << 6
+_Y_SIGN = 1 << 5
+
+
+def _fq_to_bytes(v: int) -> bytes:
+    return v.to_bytes(48, "big")
+
+
+def _y_is_large(y: int) -> bool:
+    return y > (P - 1) // 2
+
+
+def g1_to_bytes(pt) -> bytes:
+    aff = to_affine(FQ, pt)
+    if aff is None:
+        out = bytearray(48)
+        out[0] = _COMPRESSED | _INFINITY
+        return bytes(out)
+    x, y = aff
+    out = bytearray(_fq_to_bytes(x))
+    out[0] |= _COMPRESSED
+    if _y_is_large(y):
+        out[0] |= _Y_SIGN
+    return bytes(out)
+
+
+def g1_from_bytes(data: bytes):
+    """Decompress 48-byte G1 point. Raises ValueError on malformed input.
+    Subgroup membership is NOT checked here (callers decide, mirroring the
+    reference's deserialize/validate split)."""
+    if len(data) != 48:
+        raise ValueError(f"G1 compressed point must be 48 bytes, got {len(data)}")
+    flags = data[0]
+    if not flags & _COMPRESSED:
+        raise ValueError("uncompressed G1 deserialization not supported")
+    if flags & _INFINITY:
+        if any(data[1:]) or flags & ~(_COMPRESSED | _INFINITY):
+            raise ValueError("malformed G1 infinity encoding")
+        return inf(FQ)
+    x = int.from_bytes(bytes([flags & 0x1F]) + data[1:], "big")
+    if x >= P:
+        raise ValueError("G1 x coordinate >= field modulus")
+    rhs = (x * x % P * x + B1) % P
+    y = pow(rhs, (P + 1) // 4, P)
+    if y * y % P != rhs:
+        raise ValueError("G1 point not on curve")
+    if bool(flags & _Y_SIGN) != _y_is_large(y):
+        y = (-y) % P
+    return (x, y, 1)
+
+
+def g2_to_bytes(pt) -> bytes:
+    aff = to_affine(FQ2, pt)
+    if aff is None:
+        out = bytearray(96)
+        out[0] = _COMPRESSED | _INFINITY
+        return bytes(out)
+    (x0, x1), (y0, y1) = aff
+    out = bytearray(_fq_to_bytes(x1) + _fq_to_bytes(x0))
+    out[0] |= _COMPRESSED
+    if y1 > (P - 1) // 2 or (y1 == 0 and y0 > (P - 1) // 2):
+        out[0] |= _Y_SIGN
+    return bytes(out)
+
+
+def g2_from_bytes(data: bytes):
+    """Decompress 96-byte G2 point (x_c1 first, per ZCash convention)."""
+    if len(data) != 96:
+        raise ValueError(f"G2 compressed point must be 96 bytes, got {len(data)}")
+    flags = data[0]
+    if not flags & _COMPRESSED:
+        raise ValueError("uncompressed G2 deserialization not supported")
+    if flags & _INFINITY:
+        if any(data[1:]) or flags & ~(_COMPRESSED | _INFINITY):
+            raise ValueError("malformed G2 infinity encoding")
+        return inf(FQ2)
+    x1 = int.from_bytes(bytes([flags & 0x1F]) + data[1:48], "big")
+    x0 = int.from_bytes(data[48:], "big")
+    if x0 >= P or x1 >= P:
+        raise ValueError("G2 x coordinate >= field modulus")
+    x = (x0, x1)
+    rhs = F.f2_add(F.f2_mul(F.f2_sqr(x), x), B2)
+    y = F.f2_sqrt(rhs)
+    if y is None:
+        raise ValueError("G2 point not on curve")
+    y_large = y[1] > (P - 1) // 2 or (y[1] == 0 and y[0] > (P - 1) // 2)
+    if bool(flags & _Y_SIGN) != y_large:
+        y = F.f2_neg(y)
+    return (x, y, F.F2_ONE)
+
+
+def g2_clear_cofactor(pt):
+    """Map a point on E2 into the r-order subgroup G2 (multiply by h2)."""
+    return pt_mul(FQ2, pt, H2)
+
+
+def g1_clear_cofactor(pt):
+    return pt_mul(FQ, pt, H1)

@@ -280,6 +280,8 @@ def minimal_spec() -> ChainSpec:
         eth1_follow_distance=16,
         min_validator_withdrawability_delay=256,
         shard_committee_period=64,
+        min_per_epoch_churn_limit=2,
+        max_per_epoch_activation_churn_limit=4,
         churn_limit_quotient=32,
         deposit_chain_id=5,
         deposit_network_id=5,
@@ -292,6 +294,11 @@ def gnosis_spec() -> ChainSpec:
         preset_base="gnosis",
         seconds_per_slot=5,
         churn_limit_quotient=4096,
+        max_per_epoch_activation_churn_limit=2,
+        min_genesis_active_validator_count=4096,
+        genesis_delay=6000,
+        eth1_follow_distance=1024,
+        seconds_per_eth1_block=6,
         min_genesis_time=1638968400,
         genesis_fork_version=b"\x00\x00\x00\x64",
         altair_fork_version=b"\x01\x00\x00\x64",

@@ -142,6 +142,7 @@ class GnosisEthSpec(EthSpec):
 
     SLOTS_PER_EPOCH = 16
     MAX_WITHDRAWALS_PER_PAYLOAD = 8
+    EPOCHS_PER_SYNC_COMMITTEE_PERIOD = 512
 
 
 _PRESETS = {

@@ -1,0 +1,210 @@
+"""BLS12-381 curve + signature scheme tests.
+
+Oracles: published generator encodings, the reference's interop-keypair
+golden vectors (common/eth2_interop_keypairs/specs/), RFC 9380
+expand_message_xmd vectors, and algebraic self-consistency (bilinearity,
+homomorphism, subgroup orders).
+"""
+
+import hashlib
+import random
+import re
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.bls12_381 import (
+    FQ,
+    FQ2,
+    G1_GEN,
+    G2_GEN,
+    P,
+    R,
+    g1_from_bytes,
+    g1_in_subgroup,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_in_subgroup,
+    g2_to_bytes,
+    hash_to_g2,
+    inf,
+    is_inf,
+    pairing,
+    pairing_check,
+    pt_add,
+    pt_eq,
+    pt_mul,
+    pt_neg,
+)
+from lighthouse_tpu.crypto.bls12_381 import fields as F
+from lighthouse_tpu.crypto.bls12_381.hash_to_curve import expand_message_xmd
+
+
+@pytest.fixture(autouse=True)
+def host_backend():
+    bls.set_backend("host")
+    yield
+    bls.set_backend("host")
+
+
+def test_generators_valid():
+    assert g1_in_subgroup(G1_GEN)
+    assert g2_in_subgroup(G2_GEN)
+    assert is_inf(FQ, pt_mul(FQ, G1_GEN, R))
+    assert is_inf(FQ2, pt_mul(FQ2, G2_GEN, R))
+
+
+def test_known_generator_encodings():
+    assert g1_to_bytes(G1_GEN).hex() == (
+        "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+        "6c55e83ff97a1aeffb3af00adb22c6bb"
+    )
+    assert g2_to_bytes(G2_GEN).hex() == (
+        "93e02b6052719f607dacd3a088274f65596bd0d09920b61ab5da61bbdc7f5049"
+        "334cf11213945d57e5ac7d055d042b7e024aa2b2f08f0a91260805272dc51051"
+        "c6e47ad4fa403b02b4510b647ae3d1770bac0326a805bbefd48056c8c121bdb8"
+    )
+
+
+def test_point_serialization_roundtrip():
+    rng = random.Random(0)
+    for _ in range(4):
+        k = rng.randrange(1, R)
+        p1 = pt_mul(FQ, G1_GEN, k)
+        assert pt_eq(FQ, g1_from_bytes(g1_to_bytes(p1)), p1)
+        p2 = pt_mul(FQ2, G2_GEN, k)
+        assert pt_eq(FQ2, g2_from_bytes(g2_to_bytes(p2)), p2)
+    assert is_inf(FQ, g1_from_bytes(g1_to_bytes(inf(FQ))))
+    assert is_inf(FQ2, g2_from_bytes(g2_to_bytes(inf(FQ2))))
+
+
+def test_deserialize_rejects_bad_points():
+    # find an x with no curve point (rhs non-square)
+    x = 1
+    while pow((x * x * x + 4) % P, (P - 1) // 2, P) == 1:
+        x += 1
+    data = bytearray(x.to_bytes(48, "big"))
+    data[0] |= 0x80
+    with pytest.raises(ValueError):
+        g1_from_bytes(bytes(data))
+    with pytest.raises(ValueError):
+        g1_from_bytes(b"\x00" * 48)  # compression bit missing
+    with pytest.raises(ValueError):
+        g1_from_bytes(bytes([0xC0, 1]) + bytes(46))  # infinity with junk
+    with pytest.raises(ValueError):
+        g1_from_bytes(bytes([0x80]) + b"\xff" * 47)  # x >= p
+
+
+def test_pairing_bilinear():
+    e = pairing(G1_GEN, G2_GEN)
+    assert e != F.F12_ONE
+    assert F.f12_pow(e, R) == F.F12_ONE
+    a, b = 5, 9
+    lhs = pairing(pt_mul(FQ, G1_GEN, a), pt_mul(FQ2, G2_GEN, b))
+    assert lhs == F.f12_pow(e, a * b)
+    assert pairing_check([(G1_GEN, G2_GEN), (pt_neg(FQ, G1_GEN), G2_GEN)])
+
+
+def test_expand_message_xmd_rfc9380_vectors():
+    dst = b"QUUX-V01-CS02-with-expander-SHA256-128"
+    assert expand_message_xmd(b"", dst, 0x20).hex() == (
+        "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"
+    )
+    assert expand_message_xmd(b"abc", dst, 0x20).hex() == (
+        "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"
+    )
+
+
+def test_hash_to_g2_properties():
+    h = hash_to_g2(b"\x11" * 32)
+    assert g2_in_subgroup(h)
+    assert not is_inf(FQ2, h)
+    assert pt_eq(FQ2, h, hash_to_g2(b"\x11" * 32))
+    assert not pt_eq(FQ2, h, hash_to_g2(b"\x22" * 32))
+
+
+def test_interop_keypairs_match_reference_golden_vectors():
+    text = open(
+        "/root/reference/common/eth2_interop_keypairs/specs/"
+        "keygen_10_validators.yaml"
+    ).read()
+    pairs = re.findall(
+        r"privkey: '0x([0-9a-f]+)',\s*\n\s*pubkey: '0x([0-9a-f]+)'", text
+    )
+    assert len(pairs) == 10
+    for i, (sk_hex, pk_hex) in enumerate(pairs):
+        kp = bls.interop_keypairs(i + 1)[i]
+        assert kp.sk.scalar == int(sk_hex, 16)
+        assert kp.pk.to_bytes().hex() == pk_hex
+
+
+def test_sign_verify():
+    sk = bls.interop_secret_key(0)
+    pk = sk.public_key()
+    msg = hashlib.sha256(b"test message").digest()
+    sig = sk.sign(msg)
+    assert sig.verify(pk, msg)
+    assert not sig.verify(pk, hashlib.sha256(b"other").digest())
+    other_pk = bls.interop_secret_key(1).public_key()
+    assert not sig.verify(other_pk, msg)
+
+
+def test_infinity_signature_rejected():
+    pk = bls.interop_secret_key(0).public_key()
+    sig = bls.Signature(bls.INFINITY_SIGNATURE)
+    assert not sig.verify(pk, b"\x00" * 32)
+
+
+def test_aggregate_signature():
+    msg = hashlib.sha256(b"aggregate me").digest()
+    kps = bls.interop_keypairs(4)
+    agg = bls.AggregateSignature.from_signatures([kp.sk.sign(msg) for kp in kps])
+    assert agg.fast_aggregate_verify([kp.pk for kp in kps], msg)
+    assert not agg.fast_aggregate_verify([kp.pk for kp in kps[:3]], msg)
+
+
+def test_verify_signature_sets_batch():
+    kps = bls.interop_keypairs(5)
+    sets = []
+    for i, kp in enumerate(kps):
+        msg = hashlib.sha256(f"msg{i % 2}".encode()).digest()  # shared messages
+        sets.append(bls.SignatureSet.single(kp.sk.sign(msg), kp.pk, msg))
+    rng = random.Random(1234)
+    assert bls.verify_signature_sets(sets, rng)
+    # tamper one signature
+    bad = list(sets)
+    bad[2] = bls.SignatureSet.single(sets[3].signature, sets[2].pubkeys[0], sets[2].message)
+    assert not bls.verify_signature_sets(bad, random.Random(99))
+    # multi-pubkey set (aggregate attestation shape)
+    msg = hashlib.sha256(b"committee").digest()
+    agg = bls.AggregateSignature.from_signatures([kp.sk.sign(msg) for kp in kps])
+    sets.append(
+        bls.SignatureSet(
+            signature=agg.to_signature(),
+            pubkeys=[kp.pk for kp in kps],
+            message=msg,
+        )
+    )
+    assert bls.verify_signature_sets(sets, random.Random(7))
+
+
+def test_fake_crypto_backend():
+    bls.set_backend("fake_crypto")
+    sk = bls.interop_secret_key(3)
+    sig = sk.sign(b"\x01" * 32)
+    assert len(sig.to_bytes()) == 96
+    assert sig.verify(sk.public_key(), b"\x01" * 32)
+    assert bls.verify_signature_sets(
+        [bls.SignatureSet.single(sig, sk.public_key(), b"\x02" * 32)]
+    )
+    # deterministic
+    assert sk.sign(b"\x01" * 32) == sig
+
+
+def test_secret_key_roundtrip():
+    sk = bls.SecretKey.random()
+    assert bls.SecretKey.from_bytes(sk.to_bytes()).scalar == sk.scalar
+    with pytest.raises(bls.BlsError):
+        bls.SecretKey(0)
+    with pytest.raises(bls.BlsError):
+        bls.SecretKey(R)
