@@ -1,0 +1,375 @@
+"""Beacon-state accessors, predicates and mutators (spec helpers).
+
+The committee machinery mirrors the reference's per-epoch `CommitteeCache`
+(consensus/types/src/beacon_state/committee_cache.rs): one whole-list shuffle
+per epoch, committees are slices of it.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from ..types.chain_spec import FAR_FUTURE_EPOCH, ChainSpec, Domain
+from ..utils.hash import sha256 as hash_bytes
+from .shuffle import compute_shuffled_index, shuffle_list
+
+MAX_RANDOM_BYTE = 255
+
+
+def int_sqrt(n: int) -> int:
+    """Largest x with x² ≤ n (spec integer_squareroot; overflow-safe —
+    Python ints are arbitrary precision, the safe_arith analog is free)."""
+    return math.isqrt(n)
+
+
+# ---------------------------------------------------------------------------
+# Epoch / slot math
+# ---------------------------------------------------------------------------
+
+
+def compute_epoch_at_slot(slot: int, E) -> int:
+    return slot // E.SLOTS_PER_EPOCH
+
+
+def compute_start_slot_at_epoch(epoch: int, E) -> int:
+    return epoch * E.SLOTS_PER_EPOCH
+
+
+def compute_activation_exit_epoch(epoch: int, E) -> int:
+    return epoch + 1 + E.MAX_SEED_LOOKAHEAD
+
+
+def get_current_epoch(state, E) -> int:
+    return compute_epoch_at_slot(state.slot, E)
+
+
+def get_previous_epoch(state, E) -> int:
+    cur = get_current_epoch(state, E)
+    return cur - 1 if cur > 0 else 0
+
+
+# ---------------------------------------------------------------------------
+# Validator predicates
+# ---------------------------------------------------------------------------
+
+
+def is_active_validator(v, epoch: int) -> bool:
+    return v.activation_epoch <= epoch < v.exit_epoch
+
+
+def is_eligible_for_activation_queue(v, E) -> bool:
+    return (
+        v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+        and v.effective_balance == E.MAX_EFFECTIVE_BALANCE
+    )
+
+
+def is_eligible_for_activation(state, v) -> bool:
+    return (
+        v.activation_eligibility_epoch <= state.finalized_checkpoint.epoch
+        and v.activation_epoch == FAR_FUTURE_EPOCH
+    )
+
+
+def is_slashable_validator(v, epoch: int) -> bool:
+    return not v.slashed and v.activation_epoch <= epoch < v.withdrawable_epoch
+
+
+def is_slashable_attestation_data(data_1, data_2) -> bool:
+    """Double vote or surround vote (spec is_slashable_attestation_data)."""
+    double = data_1 != data_2 and data_1.target.epoch == data_2.target.epoch
+    surround = (
+        data_1.source.epoch < data_2.source.epoch
+        and data_2.target.epoch < data_1.target.epoch
+    )
+    return double or surround
+
+
+def get_active_validator_indices(state, epoch: int) -> list[int]:
+    return [
+        i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Randomness & seeds
+# ---------------------------------------------------------------------------
+
+
+def get_randao_mix(state, epoch: int, E) -> bytes:
+    return state.randao_mixes[epoch % E.EPOCHS_PER_HISTORICAL_VECTOR]
+
+
+def get_seed(state, epoch: int, domain_type: int, E) -> bytes:
+    mix = get_randao_mix(
+        state, epoch + E.EPOCHS_PER_HISTORICAL_VECTOR - E.MIN_SEED_LOOKAHEAD - 1, E
+    )
+    return hash_bytes(
+        domain_type.to_bytes(4, "little") + epoch.to_bytes(8, "little") + mix
+    )
+
+
+# ---------------------------------------------------------------------------
+# Committees
+# ---------------------------------------------------------------------------
+
+
+def get_committee_count_per_slot(active_count: int, E) -> int:
+    return max(
+        1,
+        min(
+            E.MAX_COMMITTEES_PER_SLOT,
+            active_count // E.SLOTS_PER_EPOCH // E.TARGET_COMMITTEE_SIZE,
+        ),
+    )
+
+
+@dataclass
+class CommitteeCache:
+    """One epoch's shuffling: every committee is a slice of `shuffled`
+    (committee_cache.rs analog)."""
+
+    epoch: int
+    seed: bytes
+    shuffled: list[int]
+    committees_per_slot: int
+    slots_per_epoch: int
+
+    @classmethod
+    def build(cls, state, epoch: int, E) -> "CommitteeCache":
+        active = get_active_validator_indices(state, epoch)
+        seed = get_seed(state, epoch, Domain.BEACON_ATTESTER, E)
+        shuffled = shuffle_list(active, seed, E.SHUFFLE_ROUND_COUNT)
+        return cls(
+            epoch=epoch,
+            seed=seed,
+            shuffled=shuffled,
+            committees_per_slot=get_committee_count_per_slot(len(active), E),
+            slots_per_epoch=E.SLOTS_PER_EPOCH,
+        )
+
+    @property
+    def committee_count(self) -> int:
+        return self.committees_per_slot * self.slots_per_epoch
+
+    def committee(self, slot: int, index: int) -> list[int]:
+        if index >= self.committees_per_slot:
+            raise IndexError(
+                f"committee index {index} >= {self.committees_per_slot}"
+            )
+        global_index = (
+            slot % self.slots_per_epoch
+        ) * self.committees_per_slot + index
+        n = len(self.shuffled)
+        count = self.committee_count
+        start = n * global_index // count
+        end = n * (global_index + 1) // count
+        return self.shuffled[start:end]
+
+    def active_validator_count(self) -> int:
+        return len(self.shuffled)
+
+
+class StateCaches:
+    """Per-state transient caches (committee shufflings by epoch). Attached
+    lazily to a BeaconState instance — the reference keeps these inside the
+    state object (beacon_state/committee_cache)."""
+
+    __slots__ = ("committees",)
+
+    def __init__(self):
+        self.committees: dict[int, CommitteeCache] = {}
+
+
+def _caches(state) -> StateCaches:
+    c = getattr(state, "_lh_caches", None)
+    if c is None:
+        c = StateCaches()
+        object.__setattr__(state, "_lh_caches", c)
+    return c
+
+
+def invalidate_caches(state):
+    if hasattr(state, "_lh_caches"):
+        object.__setattr__(state, "_lh_caches", StateCaches())
+
+
+def committee_cache_at(state, epoch: int, E) -> CommitteeCache:
+    cur = get_current_epoch(state, E)
+    if not (cur - 1 <= epoch <= cur + 1):
+        raise ValueError(
+            f"committee cache only for epochs {cur-1}..{cur+1}, got {epoch}"
+        )
+    caches = _caches(state)
+    cc = caches.committees.get(epoch)
+    if cc is None or cc.epoch != epoch:
+        cc = CommitteeCache.build(state, epoch, E)
+        caches.committees[epoch] = cc
+    return cc
+
+
+def get_beacon_committee(state, slot: int, index: int, E) -> list[int]:
+    epoch = compute_epoch_at_slot(slot, E)
+    return committee_cache_at(state, epoch, E).committee(slot, index)
+
+
+# ---------------------------------------------------------------------------
+# Proposer selection
+# ---------------------------------------------------------------------------
+
+
+def compute_proposer_index(state, indices: list[int], seed: bytes, E) -> int:
+    assert indices
+    total = len(indices)
+    i = 0
+    while True:
+        candidate = indices[compute_shuffled_index(i % total, total, seed, E.SHUFFLE_ROUND_COUNT)]
+        random_byte = hash_bytes(seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        eff = state.validators[candidate].effective_balance
+        if eff * MAX_RANDOM_BYTE >= E.MAX_EFFECTIVE_BALANCE * random_byte:
+            return candidate
+        i += 1
+
+
+def get_beacon_proposer_index(state, E, slot: int | None = None) -> int:
+    slot = state.slot if slot is None else slot
+    epoch = compute_epoch_at_slot(slot, E)
+    seed = hash_bytes(
+        get_seed(state, epoch, Domain.BEACON_PROPOSER, E)
+        + slot.to_bytes(8, "little")
+    )
+    indices = get_active_validator_indices(state, epoch)
+    return compute_proposer_index(state, indices, seed, E)
+
+
+# ---------------------------------------------------------------------------
+# Balances
+# ---------------------------------------------------------------------------
+
+
+def get_total_balance(state, indices, E) -> int:
+    total = sum(state.validators[i].effective_balance for i in indices)
+    return max(E.EFFECTIVE_BALANCE_INCREMENT, total)
+
+
+def get_total_active_balance(state, E) -> int:
+    return get_total_balance(
+        state, get_active_validator_indices(state, get_current_epoch(state, E)), E
+    )
+
+
+def increase_balance(state, index: int, delta: int):
+    state.balances[index] += delta
+
+
+def decrease_balance(state, index: int, delta: int):
+    state.balances[index] = max(0, state.balances[index] - delta)
+
+
+# ---------------------------------------------------------------------------
+# Block roots
+# ---------------------------------------------------------------------------
+
+
+def get_block_root_at_slot(state, slot: int, E) -> bytes:
+    if not slot < state.slot <= slot + E.SLOTS_PER_HISTORICAL_ROOT:
+        raise ValueError(f"block root for slot {slot} not available at {state.slot}")
+    return state.block_roots[slot % E.SLOTS_PER_HISTORICAL_ROOT]
+
+
+def get_block_root(state, epoch: int, E) -> bytes:
+    return get_block_root_at_slot(state, compute_start_slot_at_epoch(epoch, E), E)
+
+
+# ---------------------------------------------------------------------------
+# Attestation helpers
+# ---------------------------------------------------------------------------
+
+
+def get_attesting_indices(state, data, aggregation_bits, E) -> list[int]:
+    committee = get_beacon_committee(state, data.slot, data.index, E)
+    if len(aggregation_bits) != len(committee):
+        raise ValueError(
+            f"aggregation bits length {len(aggregation_bits)} != committee "
+            f"size {len(committee)}"
+        )
+    return sorted(i for i, bit in zip(committee, aggregation_bits) if bit)
+
+
+def get_indexed_attestation(state, attestation, E):
+    from ..types.containers import build_types
+
+    t = build_types(E)
+    indices = get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits, E
+    )
+    return t.IndexedAttestation(
+        attesting_indices=indices,
+        data=attestation.data,
+        signature=attestation.signature,
+    )
+
+
+def get_domain(state, domain_type: int, epoch: int | None, spec: ChainSpec, E) -> bytes:
+    epoch = get_current_epoch(state, E) if epoch is None else epoch
+    return spec.get_domain(
+        epoch, domain_type, state.fork, state.genesis_validators_root
+    )
+
+
+# ---------------------------------------------------------------------------
+# Validator mutators
+# ---------------------------------------------------------------------------
+
+
+def get_validator_churn_limit(state, spec: ChainSpec, E) -> int:
+    active = len(get_active_validator_indices(state, get_current_epoch(state, E)))
+    return spec.churn_limit(active)
+
+
+def initiate_validator_exit(state, index: int, spec: ChainSpec, E):
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    exit_epochs = [
+        w.exit_epoch for w in state.validators if w.exit_epoch != FAR_FUTURE_EPOCH
+    ]
+    exit_queue_epoch = max(
+        exit_epochs
+        + [compute_activation_exit_epoch(get_current_epoch(state, E), E)]
+    )
+    exit_queue_churn = sum(
+        1 for w in state.validators if w.exit_epoch == exit_queue_epoch
+    )
+    if exit_queue_churn >= get_validator_churn_limit(state, spec, E):
+        exit_queue_epoch += 1
+    v.exit_epoch = exit_queue_epoch
+    v.withdrawable_epoch = (
+        exit_queue_epoch + spec.min_validator_withdrawability_delay
+    )
+
+
+def slash_validator(
+    state, slashed_index: int, spec: ChainSpec, E, whistleblower_index=None
+):
+    epoch = get_current_epoch(state, E)
+    initiate_validator_exit(state, slashed_index, spec, E)
+    v = state.validators[slashed_index]
+    v.slashed = True
+    v.withdrawable_epoch = max(
+        v.withdrawable_epoch, epoch + E.EPOCHS_PER_SLASHINGS_VECTOR
+    )
+    state.slashings[epoch % E.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
+    decrease_balance(
+        state, slashed_index, v.effective_balance // E.MIN_SLASHING_PENALTY_QUOTIENT
+    )
+    proposer_index = get_beacon_proposer_index(state, E)
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    whistleblower_reward = v.effective_balance // E.WHISTLEBLOWER_REWARD_QUOTIENT
+    proposer_reward = whistleblower_reward // E.PROPOSER_REWARD_QUOTIENT
+    increase_balance(state, proposer_index, proposer_reward)
+    increase_balance(
+        state, whistleblower_index, whistleblower_reward - proposer_reward
+    )

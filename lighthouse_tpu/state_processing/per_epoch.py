@@ -1,0 +1,388 @@
+"""Epoch processing (phase0 base path).
+
+Mirrors consensus/state_processing/src/per_epoch_processing.rs:44-52 (phase0
+multi-pass with ValidatorStatuses; Altair+ gets the fused single-pass later).
+The per-validator sweeps are structured as index sets + whole-registry loops
+so the device (vectorized) epoch path can slot in behind the same functions.
+"""
+
+from __future__ import annotations
+
+from ..types.chain_spec import FAR_FUTURE_EPOCH, GENESIS_EPOCH, ChainSpec
+from .accessors import (
+    compute_activation_exit_epoch,
+    decrease_balance,
+    get_active_validator_indices,
+    get_attesting_indices,
+    get_block_root,
+    get_block_root_at_slot,
+    get_current_epoch,
+    get_previous_epoch,
+    get_randao_mix,
+    get_total_active_balance,
+    get_total_balance,
+    get_validator_churn_limit,
+    increase_balance,
+    initiate_validator_exit,
+    int_sqrt,
+    invalidate_caches,
+    is_active_validator,
+    is_eligible_for_activation,
+    is_eligible_for_activation_queue,
+)
+
+BASE_REWARDS_PER_EPOCH = 4
+
+
+def process_epoch(state, spec: ChainSpec, E):
+    """Phase0 epoch transition (runs at the last slot of each epoch)."""
+    process_justification_and_finalization(state, E)
+    process_rewards_and_penalties(state, spec, E)
+    process_registry_updates(state, spec, E)
+    process_slashings(state, E)
+    process_eth1_data_reset(state, E)
+    process_effective_balance_updates(state, E)
+    process_slashings_reset(state, E)
+    process_randao_mixes_reset(state, E)
+    process_historical_roots_update(state, E)
+    process_participation_record_updates(state, E)
+    invalidate_caches(state)
+
+
+# ---------------------------------------------------------------------------
+# Matching attestations
+# ---------------------------------------------------------------------------
+
+
+def get_matching_source_attestations(state, epoch: int, E):
+    current = get_current_epoch(state, E)
+    if epoch == current:
+        return list(state.current_epoch_attestations)
+    if epoch == get_previous_epoch(state, E):
+        return list(state.previous_epoch_attestations)
+    raise ValueError(f"no attestations stored for epoch {epoch}")
+
+
+def get_matching_target_attestations(state, epoch: int, E):
+    root = get_block_root(state, epoch, E)
+    return [
+        a
+        for a in get_matching_source_attestations(state, epoch, E)
+        if a.data.target.root == root
+    ]
+
+
+def get_matching_head_attestations(state, epoch: int, E):
+    return [
+        a
+        for a in get_matching_target_attestations(state, epoch, E)
+        if a.data.beacon_block_root == get_block_root_at_slot(state, a.data.slot, E)
+    ]
+
+
+def get_unslashed_attesting_indices(
+    state, attestations, E, indices_cache: dict | None = None
+) -> set[int]:
+    out: set[int] = set()
+    for a in attestations:
+        if indices_cache is not None:
+            indices = indices_cache.get(id(a))
+            if indices is None:
+                indices = get_attesting_indices(state, a.data, a.aggregation_bits, E)
+                indices_cache[id(a)] = indices
+        else:
+            indices = get_attesting_indices(state, a.data, a.aggregation_bits, E)
+        out.update(indices)
+    return {i for i in out if not state.validators[i].slashed}
+
+
+def get_attesting_balance(state, attestations, E) -> int:
+    return get_total_balance(
+        state, get_unslashed_attesting_indices(state, attestations, E), E
+    )
+
+
+# ---------------------------------------------------------------------------
+# Justification & finalization
+# ---------------------------------------------------------------------------
+
+
+def process_justification_and_finalization(state, E):
+    if get_current_epoch(state, E) <= GENESIS_EPOCH + 1:
+        return
+    previous_indices = get_unslashed_attesting_indices(
+        state,
+        get_matching_target_attestations(state, get_previous_epoch(state, E), E),
+        E,
+    )
+    current_indices = get_unslashed_attesting_indices(
+        state,
+        get_matching_target_attestations(state, get_current_epoch(state, E), E),
+        E,
+    )
+    total = get_total_active_balance(state, E)
+    prev_balance = get_total_balance(state, previous_indices, E)
+    cur_balance = get_total_balance(state, current_indices, E)
+    weigh_justification_and_finalization(state, total, prev_balance, cur_balance, E)
+
+
+def weigh_justification_and_finalization(
+    state, total_active_balance, previous_epoch_target_balance,
+    current_epoch_target_balance, E,
+):
+    from ..types.containers import build_types
+
+    t = build_types(E)
+    previous_epoch = get_previous_epoch(state, E)
+    current_epoch = get_current_epoch(state, E)
+    old_previous_justified = state.previous_justified_checkpoint
+    old_current_justified = state.current_justified_checkpoint
+
+    state.previous_justified_checkpoint = state.current_justified_checkpoint
+    bits = list(state.justification_bits)
+    bits = [False] + bits[:-1]
+    if previous_epoch_target_balance * 3 >= total_active_balance * 2:
+        state.current_justified_checkpoint = t.Checkpoint(
+            epoch=previous_epoch, root=get_block_root(state, previous_epoch, E)
+        )
+        bits[1] = True
+    if current_epoch_target_balance * 3 >= total_active_balance * 2:
+        state.current_justified_checkpoint = t.Checkpoint(
+            epoch=current_epoch, root=get_block_root(state, current_epoch, E)
+        )
+        bits[0] = True
+    state.justification_bits = bits
+
+    # Finalization (the four FFG rules)
+    if (
+        all(bits[1:4])
+        and old_previous_justified.epoch + 3 == current_epoch
+    ):
+        state.finalized_checkpoint = old_previous_justified
+    if (
+        all(bits[1:3])
+        and old_previous_justified.epoch + 2 == current_epoch
+    ):
+        state.finalized_checkpoint = old_previous_justified
+    if all(bits[0:3]) and old_current_justified.epoch + 2 == current_epoch:
+        state.finalized_checkpoint = old_current_justified
+    if all(bits[0:2]) and old_current_justified.epoch + 1 == current_epoch:
+        state.finalized_checkpoint = old_current_justified
+
+
+# ---------------------------------------------------------------------------
+# Rewards & penalties
+# ---------------------------------------------------------------------------
+
+
+def get_base_reward(state, index: int, total_balance: int, E) -> int:
+    eff = state.validators[index].effective_balance
+    return eff * E.BASE_REWARD_FACTOR // int_sqrt(total_balance) // BASE_REWARDS_PER_EPOCH
+
+
+def get_proposer_reward(state, index: int, total_balance: int, E) -> int:
+    return get_base_reward(state, index, total_balance, E) // E.PROPOSER_REWARD_QUOTIENT
+
+
+def get_finality_delay(state, E) -> int:
+    return get_previous_epoch(state, E) - state.finalized_checkpoint.epoch
+
+
+def is_in_inactivity_leak(state, E) -> bool:
+    return get_finality_delay(state, E) > E.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+
+
+def get_eligible_validator_indices(state, E) -> list[int]:
+    previous = get_previous_epoch(state, E)
+    return [
+        i
+        for i, v in enumerate(state.validators)
+        if is_active_validator(v, previous)
+        or (v.slashed and previous + 1 < v.withdrawable_epoch)
+    ]
+
+
+def _attestation_component_deltas(
+    state, attestations, total_balance, eligible, E, indices_cache
+):
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n
+    unslashed = get_unslashed_attesting_indices(state, attestations, E, indices_cache)
+    attesting_balance = get_total_balance(state, unslashed, E)
+    increment = E.EFFECTIVE_BALANCE_INCREMENT
+    leak = is_in_inactivity_leak(state, E)
+    for index in eligible:
+        base = get_base_reward(state, index, total_balance, E)
+        if index in unslashed:
+            if leak:
+                rewards[index] += base
+            else:
+                rewards[index] += (
+                    base * (attesting_balance // increment)
+                    // (total_balance // increment)
+                )
+        else:
+            penalties[index] += base
+    return rewards, penalties
+
+
+def get_attestation_deltas(state, E):
+    """Returns (rewards, penalties) arrays — phase0 get_attestation_deltas."""
+    n = len(state.validators)
+    total_balance = get_total_active_balance(state, E)
+    eligible = get_eligible_validator_indices(state, E)
+    previous = get_previous_epoch(state, E)
+
+    source_atts = get_matching_source_attestations(state, previous, E)
+    target_atts = get_matching_target_attestations(state, previous, E)
+    head_atts = get_matching_head_attestations(state, previous, E)
+
+    # One indices computation per attestation, shared by every pass below
+    # (the reference folds this into ValidatorStatuses, single pass).
+    indices_cache = {
+        id(a): get_attesting_indices(state, a.data, a.aggregation_bits, E)
+        for a in source_atts
+    }
+
+    rewards = [0] * n
+    penalties = [0] * n
+    for atts in (source_atts, target_atts, head_atts):
+        r, p = _attestation_component_deltas(
+            state, atts, total_balance, eligible, E, indices_cache
+        )
+        for i in range(n):
+            rewards[i] += r[i]
+            penalties[i] += p[i]
+
+    # Inclusion delay (proposer + timely-inclusion micro rewards)
+    for index in get_unslashed_attesting_indices(
+        state, source_atts, E, indices_cache
+    ):
+        candidates = [a for a in source_atts if index in indices_cache[id(a)]]
+        attestation = min(candidates, key=lambda a: a.inclusion_delay)
+        proposer_reward = get_proposer_reward(state, index, total_balance, E)
+        rewards[attestation.proposer_index] += proposer_reward
+        max_attester_reward = (
+            get_base_reward(state, index, total_balance, E) - proposer_reward
+        )
+        rewards[index] += max_attester_reward // attestation.inclusion_delay
+
+    # Inactivity leak penalties
+    if is_in_inactivity_leak(state, E):
+        target_attesters = get_unslashed_attesting_indices(
+            state, target_atts, E, indices_cache
+        )
+        finality_delay = get_finality_delay(state, E)
+        for index in eligible:
+            base = get_base_reward(state, index, total_balance, E)
+            penalties[index] += (
+                BASE_REWARDS_PER_EPOCH * base
+                - get_proposer_reward(state, index, total_balance, E)
+            )
+            if index not in target_attesters:
+                penalties[index] += (
+                    state.validators[index].effective_balance
+                    * finality_delay
+                    // E.INACTIVITY_PENALTY_QUOTIENT
+                )
+    return rewards, penalties
+
+
+def process_rewards_and_penalties(state, spec: ChainSpec, E):
+    if get_current_epoch(state, E) == GENESIS_EPOCH:
+        return
+    rewards, penalties = get_attestation_deltas(state, E)
+    for i in range(len(state.validators)):
+        increase_balance(state, i, rewards[i])
+        decrease_balance(state, i, penalties[i])
+
+
+# ---------------------------------------------------------------------------
+# Registry, slashings, final updates
+# ---------------------------------------------------------------------------
+
+
+def process_registry_updates(state, spec: ChainSpec, E):
+    current = get_current_epoch(state, E)
+    for index, v in enumerate(state.validators):
+        if is_eligible_for_activation_queue(v, E):
+            v.activation_eligibility_epoch = current + 1
+        if is_active_validator(v, current) and v.effective_balance <= spec.ejection_balance:
+            initiate_validator_exit(state, index, spec, E)
+    activation_queue = sorted(
+        (
+            i
+            for i, v in enumerate(state.validators)
+            if is_eligible_for_activation(state, v)
+        ),
+        key=lambda i: (state.validators[i].activation_eligibility_epoch, i),
+    )
+    for index in activation_queue[: get_validator_churn_limit(state, spec, E)]:
+        state.validators[index].activation_epoch = compute_activation_exit_epoch(
+            current, E
+        )
+
+
+def process_slashings(state, E):
+    epoch = get_current_epoch(state, E)
+    total_balance = get_total_active_balance(state, E)
+    adjusted = min(
+        sum(state.slashings) * E.PROPORTIONAL_SLASHING_MULTIPLIER, total_balance
+    )
+    increment = E.EFFECTIVE_BALANCE_INCREMENT
+    for index, v in enumerate(state.validators):
+        if v.slashed and epoch + E.EPOCHS_PER_SLASHINGS_VECTOR // 2 == v.withdrawable_epoch:
+            penalty = (
+                v.effective_balance // increment * adjusted // total_balance * increment
+            )
+            decrease_balance(state, index, penalty)
+
+
+def process_eth1_data_reset(state, E):
+    next_epoch = get_current_epoch(state, E) + 1
+    if next_epoch % E.EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
+        state.eth1_data_votes = []
+
+
+def process_effective_balance_updates(state, E):
+    hysteresis_increment = E.EFFECTIVE_BALANCE_INCREMENT // E.HYSTERESIS_QUOTIENT
+    downward = hysteresis_increment * E.HYSTERESIS_DOWNWARD_MULTIPLIER
+    upward = hysteresis_increment * E.HYSTERESIS_UPWARD_MULTIPLIER
+    for index, v in enumerate(state.validators):
+        balance = state.balances[index]
+        if balance + downward < v.effective_balance or v.effective_balance + upward < balance:
+            v.effective_balance = min(
+                balance - balance % E.EFFECTIVE_BALANCE_INCREMENT,
+                E.MAX_EFFECTIVE_BALANCE,
+            )
+
+
+def process_slashings_reset(state, E):
+    next_epoch = get_current_epoch(state, E) + 1
+    state.slashings[next_epoch % E.EPOCHS_PER_SLASHINGS_VECTOR] = 0
+
+
+def process_randao_mixes_reset(state, E):
+    current = get_current_epoch(state, E)
+    next_epoch = current + 1
+    state.randao_mixes[next_epoch % E.EPOCHS_PER_HISTORICAL_VECTOR] = get_randao_mix(
+        state, current, E
+    )
+
+
+def process_historical_roots_update(state, E):
+    next_epoch = get_current_epoch(state, E) + 1
+    if next_epoch % (E.SLOTS_PER_HISTORICAL_ROOT // E.SLOTS_PER_EPOCH) == 0:
+        from ..types.containers import build_types
+
+        t = build_types(E)
+        batch = t.HistoricalBatch(
+            block_roots=state.block_roots, state_roots=state.state_roots
+        )
+        state.historical_roots.append(batch.hash_tree_root())
+
+
+def process_participation_record_updates(state, E):
+    state.previous_epoch_attestations = state.current_epoch_attestations
+    state.current_epoch_attestations = []

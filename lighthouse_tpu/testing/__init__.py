@@ -1,0 +1,1 @@
+"""Test fixtures (the reference's testing/ + test_utils capability set)."""
