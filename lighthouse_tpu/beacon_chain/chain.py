@@ -1,0 +1,401 @@
+"""BeaconChain: the chain orchestrator.
+
+Mirrors beacon_node/beacon_chain/src/beacon_chain.rs: the block import
+pipeline (typestate progression GossipVerified → SignatureVerified →
+fully-imported, block_verification.rs:21-45), attestation processing into
+fork choice + op pool, canonical-head recomputation (canonical_head.rs:473),
+block production (produce_block_on_state, beacon_chain.rs:4720), snapshot
+cache, and finalization-driven pruning/migration (migrate.rs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..fork_choice import ForkChoice
+from ..state_processing import (
+    BlockProcessingError,
+    BlockSignatureStrategy,
+    ConsensusContext,
+    per_block_processing,
+    per_slot_processing,
+)
+from ..state_processing import signature_sets as sigsets
+from ..state_processing.accessors import (
+    compute_epoch_at_slot,
+    compute_start_slot_at_epoch,
+    get_beacon_proposer_index,
+    get_current_epoch,
+)
+from ..store import HotColdDB
+from ..types.chain_spec import ChainSpec
+from ..utils.slot_clock import SlotClock
+from .attestation_verification import (
+    AttestationError,
+    AttestationVerifier,
+    ObservedCache,
+)
+from .op_pool import OperationPool
+
+
+class BlockError(ValueError):
+    pass
+
+
+@dataclass
+class GossipVerifiedBlock:
+    """Typestate stage 1: header/proposer-signature checked
+    (block_verification.rs:770-1027)."""
+
+    signed_block: object
+    block_root: bytes
+
+
+@dataclass
+class ChainSegmentResult:
+    imported: int
+    error: BlockError | None = None
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        store: HotColdDB,
+        genesis_state,
+        spec: ChainSpec,
+        E,
+        slot_clock: SlotClock,
+    ):
+        from ..types.containers import build_types
+
+        self.spec = spec
+        self.E = E
+        self.types = build_types(E)
+        self.store = store
+        self.store.types = self.types
+        self.slot_clock = slot_clock
+        self.op_pool = OperationPool(spec, E)
+        self.observed_attesters = ObservedCache()
+        self.observed_aggregators = ObservedCache()
+        self.observed_block_producers = ObservedCache()
+        self.attestation_verifier = AttestationVerifier(self)
+
+        genesis_root = _genesis_block_root(genesis_state, self.types)
+        self.genesis_block_root = genesis_root
+        self.genesis_validators_root = genesis_state.genesis_validators_root
+
+        # snapshot cache: block_root -> post-state (the reference's
+        # snapshot/state caches; bounded by pruning at finality)
+        self._states: dict[bytes, object] = {genesis_root: genesis_state}
+        self._blocks_by_root: dict[bytes, object] = {}
+        self.head_root = genesis_root
+
+        self.fork_choice = ForkChoice.from_anchor(
+            genesis_root, genesis_state, spec, E
+        )
+        # Justified balances come from the actual justified state when the
+        # snapshot cache still holds it.
+        self.fork_choice.state_provider = self._states.get
+        store.put_state(genesis_state.hash_tree_root(), genesis_state)
+
+    # ------------------------------------------------------------------ head
+
+    @property
+    def head_state(self):
+        return self._states[self.head_root]
+
+    def head_block(self):
+        return self._blocks_by_root.get(self.head_root)
+
+    def recompute_head(self):
+        """canonical_head.rs:473 recompute_head_at_current_slot."""
+        new_head = self.fork_choice.get_head(self.slot_clock.now())
+        if new_head != self.head_root and new_head in self._states:
+            self.head_root = new_head
+        return self.head_root
+
+    @property
+    def finalized_checkpoint(self):
+        return self.fork_choice.store.finalized_checkpoint
+
+    @property
+    def justified_checkpoint(self):
+        return self.fork_choice.store.justified_checkpoint
+
+    # ------------------------------------------------------------------ states
+
+    def state_for_attestation_epoch(self, target_epoch: int):
+        """A state whose committee caches cover `target_epoch` (shuffling
+        cache role). Advances a copy of the head state if it lags."""
+        state = self.head_state
+        cur = get_current_epoch(state, self.E)
+        if target_epoch <= cur + 1 and target_epoch >= max(0, cur - 1):
+            return state
+        if target_epoch > cur + 1:
+            state = state.copy()
+            target_slot = compute_start_slot_at_epoch(target_epoch, self.E)
+            while state.slot < target_slot:
+                per_slot_processing(state, self.spec, self.E)
+            return state
+        raise AttestationError(f"target epoch {target_epoch} too old for head")
+
+    def state_at_block_root(self, block_root: bytes):
+        return self._states.get(block_root)
+
+    def _indexed_from(self, state, attestation, indices):
+        return self.types.IndexedAttestation(
+            attesting_indices=indices,
+            data=attestation.data,
+            signature=attestation.signature,
+        )
+
+    # ------------------------------------------------------------------ import
+
+    def verify_block_for_gossip(self, signed_block) -> GossipVerifiedBlock:
+        """Stage 1: structural + proposer-signature verification
+        (GossipVerifiedBlock::new)."""
+        block = signed_block.message
+        block_root = block.hash_tree_root()
+        current_slot = self.slot_clock.now()
+        if block.slot > current_slot:
+            raise BlockError(f"future block (slot {block.slot} > {current_slot})")
+        if self.fork_choice.contains_block(block_root):
+            raise BlockError("block already known")
+        if not self.fork_choice.contains_block(block.parent_root):
+            raise BlockError("parent unknown")
+        finalized_slot = compute_start_slot_at_epoch(
+            self.finalized_checkpoint.epoch, self.E
+        )
+        if block.slot <= finalized_slot:
+            raise BlockError("block is prior to finalization")
+        if self.observed_block_producers.is_known(block.slot, block.proposer_index):
+            raise BlockError("proposer already produced a block at this slot")
+        parent_state = self._pre_state_for(block)
+        if not sigsets.block_proposal_signature_set(
+            parent_state, signed_block, block_root, self.spec, self.E
+        ).verify():
+            raise BlockError("invalid proposer signature")
+        self.observed_block_producers.observe(block.slot, block.proposer_index)
+        return GossipVerifiedBlock(signed_block=signed_block, block_root=block_root)
+
+    def _pre_state_for(self, block):
+        """Parent post-state advanced to the block's slot (the
+        cheap_state_advance / catchup_state path)."""
+        parent_state = self._states.get(block.parent_root)
+        if parent_state is None:
+            raise BlockError(f"no state for parent {block.parent_root.hex()[:16]}")
+        state = parent_state.copy()
+        while state.slot < block.slot:
+            per_slot_processing(state, self.spec, self.E)
+        return state
+
+    def process_block(self, block_input) -> bytes:
+        """Full import (beacon_chain.rs:3035 process_block → :3362
+        import_block): state transition with bulk signature verification,
+        store write, fork-choice registration (block + its attestations),
+        head recompute."""
+        if isinstance(block_input, GossipVerifiedBlock):
+            signed_block = block_input.signed_block
+            block_root = block_input.block_root
+            proposal_verified = True  # checked in verify_block_for_gossip
+        else:
+            signed_block = block_input
+            block_root = signed_block.message.hash_tree_root()
+            proposal_verified = False
+        block = signed_block.message
+
+        if self.fork_choice.contains_block(block_root):
+            return block_root  # idempotent
+        if not self.fork_choice.contains_block(block.parent_root):
+            raise BlockError("parent unknown")
+        current_slot = self.slot_clock.now()
+        if block.slot > current_slot:
+            raise BlockError(
+                f"future block: slot {block.slot} > clock {current_slot}"
+            )
+
+        state = self._pre_state_for(block)
+        ctxt = ConsensusContext(block.slot)
+        try:
+            per_block_processing(
+                state,
+                signed_block,
+                self.spec,
+                self.E,
+                strategy=BlockSignatureStrategy.VERIFY_BULK,
+                ctxt=ctxt,
+                block_root=block_root,
+                proposal_already_verified=proposal_verified,
+            )
+        except BlockProcessingError as e:
+            raise BlockError(f"invalid block: {e}") from e
+
+        # import_block: store + fork choice + head
+        is_timely = (
+            block.slot == current_slot
+            and self.slot_clock.seconds_into_slot()
+            < self.spec.seconds_per_slot / 3
+        )
+        self.fork_choice.on_block(
+            current_slot, block, block_root, state, is_timely=is_timely
+        )
+        for att in block.body.attestations:
+            try:
+                indexed = ctxt.get_indexed_attestation(state, att, self.E)
+                self.fork_choice.on_attestation(indexed, is_from_block=True)
+            except Exception:
+                continue  # fork-choice-irrelevant attestations are skipped
+
+        self.store.put_block(block_root, signed_block)
+        self.store.put_state(block.state_root, state)
+        self._states[block_root] = state
+        self._blocks_by_root[block_root] = signed_block
+
+        self.recompute_head()
+        self.op_pool.prune(self.head_state)
+        self._prune_at_finality()
+        return block_root
+
+    def process_chain_segment(self, blocks) -> ChainSegmentResult:
+        """Range-sync import: one bulk signature batch across all blocks
+        would mirror signature_verify_chain_segment (block_verification.rs:
+        568); blocks are applied sequentially with per-block bulk batches
+        for now."""
+        imported = 0
+        for signed_block in blocks:
+            try:
+                self.process_block(signed_block)
+                imported += 1
+            except BlockError as e:
+                return ChainSegmentResult(imported=imported, error=e)
+        return ChainSegmentResult(imported=imported)
+
+    def _prune_at_finality(self):
+        """Drop snapshot-cache states that can no longer become head, and
+        migrate finalized blocks to the cold DB (migrate.rs)."""
+        finalized = self.finalized_checkpoint
+        if finalized.epoch == 0:
+            return
+        finalized_slot = compute_start_slot_at_epoch(finalized.epoch, self.E)
+        droppable = [
+            root
+            for root, st in self._states.items()
+            if st.slot < finalized_slot and root != self.head_root
+            and root != finalized.root
+        ]
+        migrated = []
+        for root in droppable:
+            st = self._states.pop(root, None)
+            if st is not None:
+                # hot DB keeps only unfinalized states (hot_cold_store split);
+                # the block already carries the state root — no re-hash.
+                blk = self._blocks_by_root.get(root)
+                state_root = (
+                    blk.message.state_root if blk is not None else st.hash_tree_root()
+                )
+                self.store.delete_state(state_root)
+            if self.fork_choice.proto.proto_array.is_descendant(
+                root, finalized.root
+            ):
+                # canonical ancestor of the finalized checkpoint → cold DB
+                migrated.append(root)
+            else:
+                # pruned fork: drop entirely
+                self._blocks_by_root.pop(root, None)
+        if migrated:
+            self.store.migrate_to_cold(finalized_slot, migrated)
+        self.observed_attesters.prune(finalized.epoch)
+        self.observed_aggregators.prune(finalized.epoch)
+        self.observed_block_producers.prune(finalized_slot)  # keyed by slot
+
+    # ------------------------------------------------------------------ gossip attestations
+
+    def process_attestation(self, attestation):
+        """Verify a gossip attestation, feed fork choice + op pool."""
+        verified = self.attestation_verifier.verify_unaggregated(attestation)
+        self.apply_attestation_to_fork_choice(verified.indexed_attestation)
+        self.op_pool.insert_attestation(attestation)
+        return verified
+
+    def process_attestation_batch(self, attestations) -> list:
+        results = self.attestation_verifier.batch_verify_unaggregated(
+            attestations
+        )
+        for att, res in zip(attestations, results):
+            if not isinstance(res, Exception):
+                self.apply_attestation_to_fork_choice(res.indexed_attestation)
+                self.op_pool.insert_attestation(att)
+        return results
+
+    def process_aggregate(self, signed_aggregate):
+        verified = self.attestation_verifier.verify_aggregated(signed_aggregate)
+        self.apply_attestation_to_fork_choice(verified.indexed_attestation)
+        self.op_pool.insert_attestation(signed_aggregate.message.aggregate)
+        return verified
+
+    def apply_attestation_to_fork_choice(self, indexed):
+        try:
+            self.fork_choice.on_attestation(indexed, is_from_block=False)
+        except Exception:
+            pass  # gossip attestations may be for unviable targets
+
+    # ------------------------------------------------------------------ production
+
+    def produce_block_on_state(
+        self, slot: int, randao_reveal: bytes, graffiti: bytes = b"\x00" * 32
+    ):
+        """Unsigned block on the current head (beacon_chain.rs:4137,4720):
+        advances head state, packs the op pool, computes the state root.
+        Returns (block, post_state)."""
+        state = self.head_state.copy()
+        parent_root = self.head_root
+        while state.slot < slot:
+            per_slot_processing(state, self.spec, self.E)
+        proposer = get_beacon_proposer_index(state, self.E)
+        attestations = self.op_pool.get_attestations_for_block(state)
+        proposer_slashings, attester_slashings, exits = (
+            self.op_pool.get_slashings_and_exits(state)
+        )
+        body = self.types.BeaconBlockBody(
+            randao_reveal=randao_reveal,
+            eth1_data=state.eth1_data,
+            graffiti=graffiti,
+            proposer_slashings=proposer_slashings,
+            attester_slashings=attester_slashings,
+            attestations=attestations,
+            voluntary_exits=exits,
+        )
+        block = self.types.BeaconBlock(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=parent_root,
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+        post = state.copy()
+        ctxt = ConsensusContext(slot)
+        ctxt.set_proposer_index(proposer)
+        per_block_processing(
+            post,
+            self.types.SignedBeaconBlock(message=block),
+            self.spec,
+            self.E,
+            strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            ctxt=ctxt,
+            verify_block_root=False,
+        )
+        block.state_root = post.hash_tree_root()
+        return block, post
+
+
+def _genesis_block_root(genesis_state, types) -> bytes:
+    """Root of the implicit genesis block (header over the genesis state)."""
+    header = genesis_state.latest_block_header
+    filled = types.BeaconBlockHeader(
+        slot=header.slot,
+        proposer_index=header.proposer_index,
+        parent_root=header.parent_root,
+        state_root=genesis_state.hash_tree_root(),
+        body_root=header.body_root,
+    )
+    return filled.hash_tree_root()

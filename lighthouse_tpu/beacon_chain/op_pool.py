@@ -1,0 +1,154 @@
+"""Operation pool: attestations/slashings/exits for block production.
+
+Mirrors beacon_node/operation_pool: attestations aggregated per
+AttestationData, greedy max-cover packing for block inclusion
+(max_cover.rs / attestation.rs AttMaxCover), SSZ persistence hooks.
+"""
+
+from __future__ import annotations
+
+from ..crypto import bls
+from ..state_processing.accessors import (
+    compute_epoch_at_slot,
+    get_current_epoch,
+    get_previous_epoch,
+)
+
+
+class OperationPool:
+    def __init__(self, spec, E):
+        self.spec = spec
+        self.E = E
+        # data_root -> {bits_tuple: attestation}; kept disaggregated enough
+        # to re-aggregate disjoint sets at packing time
+        self._attestations: dict[bytes, dict[tuple, object]] = {}
+        self._attestation_data_slot: dict[bytes, int] = {}
+        self._proposer_slashings: dict[int, object] = {}
+        self._attester_slashings: list = []
+        self._voluntary_exits: dict[int, object] = {}
+
+    # -- insert -------------------------------------------------------------
+
+    # Max running aggregates kept per AttestationData (bounds memory; the
+    # reference's naive aggregation pool keeps one per data + overlap spill).
+    MAX_AGGREGATES_PER_DATA = 16
+
+    def insert_attestation(self, attestation):
+        """Greedy in-place aggregation: merge into the first disjoint stored
+        aggregate (replacing it), else keep standalone up to a cap — linear
+        work per insert, no combinatorial growth."""
+        data_root = attestation.data.hash_tree_root()
+        bucket = self._attestations.setdefault(data_root, {})
+        self._attestation_data_slot[data_root] = attestation.data.slot
+        key = tuple(attestation.aggregation_bits)
+        if key in bucket:
+            return
+        for other_key, other in bucket.items():
+            if not any(a and b for a, b in zip(key, other_key)):
+                merged_bits = [a or b for a, b in zip(key, other_key)]
+                agg = bls.AggregateSignature.from_signatures(
+                    [
+                        bls.Signature(attestation.signature),
+                        bls.Signature(other.signature),
+                    ]
+                )
+                t = type(attestation)
+                merged = t(
+                    aggregation_bits=merged_bits,
+                    data=attestation.data,
+                    signature=agg.to_signature().to_bytes(),
+                )
+                del bucket[other_key]
+                bucket[tuple(merged_bits)] = merged
+                return
+        if len(bucket) < self.MAX_AGGREGATES_PER_DATA:
+            bucket[key] = attestation
+
+    def insert_proposer_slashing(self, slashing):
+        self._proposer_slashings[
+            slashing.signed_header_1.message.proposer_index
+        ] = slashing
+
+    def insert_attester_slashing(self, slashing):
+        self._attester_slashings.append(slashing)
+
+    def insert_voluntary_exit(self, exit_):
+        self._voluntary_exits[exit_.message.validator_index] = exit_
+
+    # -- packing ------------------------------------------------------------
+
+    def get_attestations_for_block(self, state) -> list:
+        """Greedy max-cover: prefer attestations adding the most not-yet-
+        covered attesters (operation_pool/src/max_cover.rs)."""
+        E = self.E
+        current = get_current_epoch(state, E)
+        previous = get_previous_epoch(state, E)
+        candidates = []
+        for data_root, bucket in self._attestations.items():
+            for att in bucket.values():
+                data = att.data
+                epoch = data.target.epoch
+                if epoch not in (current, previous):
+                    continue
+                if not (
+                    data.slot + E.MIN_ATTESTATION_INCLUSION_DELAY
+                    <= state.slot
+                    <= data.slot + E.SLOTS_PER_EPOCH
+                ):
+                    continue
+                source_ok = (
+                    data.source == state.current_justified_checkpoint
+                    if epoch == current
+                    else data.source == state.previous_justified_checkpoint
+                )
+                if source_ok:
+                    candidates.append(att)
+
+        chosen: list = []
+        covered: set[tuple[bytes, int]] = set()
+        while candidates and len(chosen) < E.MAX_ATTESTATIONS:
+            def gain(att):
+                dr = att.data.hash_tree_root()
+                return sum(
+                    1
+                    for i, bit in enumerate(att.aggregation_bits)
+                    if bit and (dr, i) not in covered
+                )
+
+            best = max(candidates, key=gain)
+            if gain(best) == 0:
+                break
+            candidates.remove(best)
+            chosen.append(best)
+            dr = best.data.hash_tree_root()
+            covered.update(
+                (dr, i) for i, bit in enumerate(best.aggregation_bits) if bit
+            )
+        return chosen
+
+    def get_slashings_and_exits(self, state) -> tuple[list, list, list]:
+        E = self.E
+        proposer_slashings = list(self._proposer_slashings.values())[
+            : E.MAX_PROPOSER_SLASHINGS
+        ]
+        attester_slashings = self._attester_slashings[: E.MAX_ATTESTER_SLASHINGS]
+        exits = list(self._voluntary_exits.values())[: E.MAX_VOLUNTARY_EXITS]
+        return proposer_slashings, attester_slashings, exits
+
+    # -- pruning ------------------------------------------------------------
+
+    def prune(self, state):
+        """Drop operations no longer includable (prune_all analog)."""
+        E = self.E
+        previous = get_previous_epoch(state, E)
+        stale = [
+            dr
+            for dr, slot in self._attestation_data_slot.items()
+            if compute_epoch_at_slot(slot, E) < previous
+        ]
+        for dr in stale:
+            self._attestations.pop(dr, None)
+            self._attestation_data_slot.pop(dr, None)
+
+    def num_attestations(self) -> int:
+        return sum(len(b) for b in self._attestations.values())

@@ -1,0 +1,33 @@
+"""Fork choice (consensus/{fork_choice,proto_array} equivalent)."""
+
+from .fork_choice import (
+    Checkpoint,
+    ForkChoice,
+    ForkChoiceError,
+    ForkChoiceStore,
+    InvalidAttestation,
+    InvalidBlock,
+)
+from .proto_array import (
+    ExecutionStatus,
+    ProtoArray,
+    ProtoArrayError,
+    ProtoArrayForkChoice,
+    ProtoNode,
+    VoteTracker,
+)
+
+__all__ = [
+    "Checkpoint",
+    "ForkChoice",
+    "ForkChoiceError",
+    "ForkChoiceStore",
+    "InvalidAttestation",
+    "InvalidBlock",
+    "ExecutionStatus",
+    "ProtoArray",
+    "ProtoArrayError",
+    "ProtoArrayForkChoice",
+    "ProtoNode",
+    "VoteTracker",
+]

@@ -1,0 +1,295 @@
+"""Spec fork choice over the proto-array
+(consensus/fork_choice/src/fork_choice.rs: on_block :642, on_attestation
+:1037, get_head :468, proposer boost, equivocation handling).
+
+The store tracks justified/finalized checkpoints and the proposer boost;
+weights come from the justified state's effective balances, supplied by a
+`balances_provider` (the beacon chain's justified-balances cache in the
+reference)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..state_processing.accessors import (
+    compute_epoch_at_slot,
+    compute_start_slot_at_epoch,
+    get_active_validator_indices,
+    get_current_epoch,
+)
+from ..types.chain_spec import GENESIS_EPOCH, ChainSpec
+
+
+class ForkChoiceError(ValueError):
+    pass
+
+
+class InvalidAttestation(ForkChoiceError):
+    pass
+
+
+class InvalidBlock(ForkChoiceError):
+    pass
+
+
+@dataclass
+class Checkpoint:
+    epoch: int
+    root: bytes
+
+
+@dataclass
+class ForkChoiceStore:
+    """Spec Store subset (fork_choice_store.rs trait surface)."""
+
+    current_slot: int
+    justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    unrealized_justified_checkpoint: Checkpoint
+    unrealized_finalized_checkpoint: Checkpoint
+    proposer_boost_root: bytes = b"\x00" * 32
+    equivocating_indices: set[int] = field(default_factory=set)
+
+
+class ForkChoice:
+    def __init__(self, store: ForkChoiceStore, proto, spec: ChainSpec, E):
+        from .proto_array import ProtoArrayForkChoice
+
+        self.store = store
+        self.proto: ProtoArrayForkChoice = proto
+        self.spec = spec
+        self.E = E
+        # Effective balances of active validators at the justified state.
+        self._justified_balances: list[int] = []
+        # Optional: block_root -> state, so justified balances come from the
+        # actual justified checkpoint state (the reference's justified
+        # balances cache); falls back to the importing block's state.
+        self.state_provider = None
+
+    # ------------------------------------------------------------------ init
+
+    @classmethod
+    def from_anchor(cls, anchor_root: bytes, anchor_state, spec: ChainSpec, E):
+        """Initialize from a genesis or checkpoint (weak subjectivity) state
+        (fork_choice.rs from_anchor)."""
+        from .proto_array import ProtoArrayForkChoice
+
+        epoch = get_current_epoch(anchor_state, E)
+        cp = Checkpoint(epoch=max(epoch, GENESIS_EPOCH), root=anchor_root)
+        store = ForkChoiceStore(
+            current_slot=anchor_state.slot,
+            justified_checkpoint=cp,
+            finalized_checkpoint=cp,
+            unrealized_justified_checkpoint=cp,
+            unrealized_finalized_checkpoint=cp,
+        )
+        proto = ProtoArrayForkChoice(
+            finalized_root=anchor_root,
+            finalized_slot=anchor_state.slot,
+            finalized_state_root=anchor_state.hash_tree_root(),
+            justified_epoch=cp.epoch,
+            finalized_epoch=cp.epoch,
+        )
+        fc = cls(store, proto, spec, E)
+        fc._justified_balances = _active_balances(anchor_state, E)
+        return fc
+
+    # ------------------------------------------------------------------ ticks
+
+    def on_tick(self, slot: int):
+        """Advance wall-clock slot; reset proposer boost at slot start
+        (fork_choice.rs update_time/on_tick_per_slot)."""
+        while self.store.current_slot < slot:
+            self.store.current_slot += 1
+            self.store.proposer_boost_root = b"\x00" * 32
+
+    # ------------------------------------------------------------------ block
+
+    def on_block(
+        self,
+        current_slot: int,
+        block,
+        block_root: bytes,
+        state,
+        is_timely: bool = False,
+    ):
+        """Register an imported block (fork_choice.rs:642). `state` is the
+        post-state; unrealized checkpoints are drawn from it by running
+        justification processing on a throwaway copy."""
+        self.on_tick(max(current_slot, self.store.current_slot))
+        if block.slot > current_slot:
+            raise InvalidBlock(f"future block: {block.slot} > {current_slot}")
+        if not self.proto.contains_block(block.parent_root):
+            raise InvalidBlock("unknown parent")
+        finalized_slot = compute_start_slot_at_epoch(
+            self.store.finalized_checkpoint.epoch, self.E
+        )
+        if block.slot <= finalized_slot:
+            raise InvalidBlock("block conflicts with finality (too old)")
+        if not self.proto.proto_array.is_descendant(
+            self.store.finalized_checkpoint.root, block.parent_root
+        ):
+            raise InvalidBlock("block does not descend from finalized root")
+
+        # Proposer boost: first timely block for the current slot.
+        if (
+            is_timely
+            and block.slot == current_slot
+            and self.store.proposer_boost_root == b"\x00" * 32
+        ):
+            self.store.proposer_boost_root = block_root
+
+        unrealized_j, unrealized_f = self._compute_unrealized_checkpoints(state)
+
+        # Checkpoint update rules (pull-up tips)
+        self._update_checkpoints(
+            Checkpoint(
+                state.current_justified_checkpoint.epoch,
+                state.current_justified_checkpoint.root,
+            ),
+            Checkpoint(
+                state.finalized_checkpoint.epoch, state.finalized_checkpoint.root
+            ),
+            state,
+        )
+        if unrealized_j.epoch > self.store.unrealized_justified_checkpoint.epoch:
+            self.store.unrealized_justified_checkpoint = unrealized_j
+        if unrealized_f.epoch > self.store.unrealized_finalized_checkpoint.epoch:
+            self.store.unrealized_finalized_checkpoint = unrealized_f
+        # Blocks from prior epochs are pulled up immediately.
+        if compute_epoch_at_slot(block.slot, self.E) < compute_epoch_at_slot(
+            current_slot, self.E
+        ):
+            self._update_checkpoints(unrealized_j, unrealized_f, state)
+
+        self.proto.on_block(
+            slot=block.slot,
+            root=block_root,
+            parent_root=block.parent_root,
+            state_root=block.state_root,
+            justified_epoch=state.current_justified_checkpoint.epoch,
+            finalized_epoch=state.finalized_checkpoint.epoch,
+            unrealized_justified_epoch=unrealized_j.epoch,
+            unrealized_finalized_epoch=unrealized_f.epoch,
+        )
+
+    def _update_checkpoints(self, justified: Checkpoint, finalized: Checkpoint, state):
+        if justified.epoch > self.store.justified_checkpoint.epoch:
+            self.store.justified_checkpoint = justified
+            balance_state = None
+            if self.state_provider is not None:
+                balance_state = self.state_provider(justified.root)
+            if balance_state is None:
+                balance_state = state
+            self._justified_balances = _active_balances(
+                balance_state, self.E, at_epoch=justified.epoch
+            )
+        if finalized.epoch > self.store.finalized_checkpoint.epoch:
+            self.store.finalized_checkpoint = finalized
+            self.proto.proto_array.maybe_prune(finalized.root)
+
+    def _compute_unrealized_checkpoints(self, state):
+        """Run justification on a throwaway copy to see what this chain tip
+        would justify at the next boundary (compute_pulled_up_tip)."""
+        from ..state_processing.per_epoch import (
+            process_justification_and_finalization,
+        )
+
+        epoch = get_current_epoch(state, self.E)
+        if epoch <= GENESIS_EPOCH + 1:
+            return (
+                Checkpoint(
+                    state.current_justified_checkpoint.epoch,
+                    state.current_justified_checkpoint.root,
+                ),
+                Checkpoint(
+                    state.finalized_checkpoint.epoch,
+                    state.finalized_checkpoint.root,
+                ),
+            )
+        tmp = state.copy()
+        process_justification_and_finalization(tmp, self.E)
+        return (
+            Checkpoint(
+                tmp.current_justified_checkpoint.epoch,
+                tmp.current_justified_checkpoint.root,
+            ),
+            Checkpoint(
+                tmp.finalized_checkpoint.epoch, tmp.finalized_checkpoint.root
+            ),
+        )
+
+    # ------------------------------------------------------------------ votes
+
+    def on_attestation(self, indexed_attestation, is_from_block: bool = False):
+        """Track latest messages (fork_choice.rs:1037)."""
+        data = indexed_attestation.data
+        self._validate_on_attestation(data, is_from_block)
+        for vi in indexed_attestation.attesting_indices:
+            if vi not in self.store.equivocating_indices:
+                self.proto.process_attestation(
+                    vi, data.beacon_block_root, data.target.epoch
+                )
+
+    def _validate_on_attestation(self, data, is_from_block: bool):
+        # Recency applies to gossip only; attestations carried in blocks may
+        # be arbitrarily old when syncing (spec validate_on_attestation).
+        if not is_from_block:
+            current_epoch = compute_epoch_at_slot(
+                self.store.current_slot, self.E
+            )
+            if data.target.epoch not in (
+                current_epoch,
+                max(0, current_epoch - 1),
+            ):
+                raise InvalidAttestation(
+                    f"target epoch {data.target.epoch} not current/previous"
+                )
+        if data.target.epoch != compute_epoch_at_slot(data.slot, self.E):
+            raise InvalidAttestation("target epoch does not match slot")
+        if not self.proto.contains_block(data.target.root):
+            raise InvalidAttestation("unknown target root")
+        if not self.proto.contains_block(data.beacon_block_root):
+            raise InvalidAttestation("unknown head block")
+        head_slot = self.proto.block_slot(data.beacon_block_root)
+        if head_slot is not None and head_slot > data.slot:
+            raise InvalidAttestation("attestation to a future block")
+        if not is_from_block and self.store.current_slot < data.slot + 1:
+            raise InvalidAttestation("attestation from the future")
+
+    def on_equivocation(self, validator_indices):
+        self.store.equivocating_indices.update(validator_indices)
+
+    # ------------------------------------------------------------------ head
+
+    def get_head(self, current_slot: int | None = None) -> bytes:
+        """Recompute and return the canonical head root (fork_choice.rs:468)."""
+        if current_slot is not None:
+            self.on_tick(current_slot)
+        boost_amount = 0
+        if self.store.proposer_boost_root != b"\x00" * 32:
+            total = sum(self._justified_balances)
+            committee_weight = total // self.E.SLOTS_PER_EPOCH
+            boost_amount = (
+                committee_weight * self.spec.proposer_score_boost // 100
+            )
+        return self.proto.get_head(
+            justified_checkpoint_root=self.store.justified_checkpoint.root,
+            justified_epoch=self.store.justified_checkpoint.epoch,
+            finalized_epoch=self.store.finalized_checkpoint.epoch,
+            justified_state_balances=self._justified_balances,
+            proposer_boost_root=self.store.proposer_boost_root,
+            proposer_boost_amount=boost_amount,
+            equivocating_indices=self.store.equivocating_indices,
+        )
+
+    def contains_block(self, root: bytes) -> bool:
+        return self.proto.contains_block(root)
+
+
+def _active_balances(state, E, at_epoch: int | None = None) -> list[int]:
+    epoch = get_current_epoch(state, E) if at_epoch is None else at_epoch
+    return [
+        v.effective_balance if v.activation_epoch <= epoch < v.exit_epoch else 0
+        for v in state.validators
+    ]

@@ -193,16 +193,19 @@ def per_block_processing(
     ctxt: ConsensusContext | None = None,
     block_root: bytes | None = None,
     verify_block_root: bool = True,
+    proposal_already_verified: bool = False,
 ):
     """Apply `signed_block` to `state` in place. Raises BlockProcessingError
     on ANY invalid condition (per_block_processing.rs:100) — malformed
     indices/slots surface as BlockProcessingError, never as raw
     IndexError/ValueError (the reference's fallible set constructors return
-    ValidatorUnknown etc.)."""
+    ValidatorUnknown etc.). `proposal_already_verified` skips the proposer
+    signature (the SignatureVerifiedBlock::from_gossip_verified_block path,
+    block_verification.rs:1084)."""
     try:
         _per_block_processing_inner(
             state, signed_block, spec, E, strategy, ctxt, block_root,
-            verify_block_root,
+            verify_block_root, proposal_already_verified,
         )
     except BlockProcessingError:
         raise
@@ -211,7 +214,8 @@ def per_block_processing(
 
 
 def _per_block_processing_inner(
-    state, signed_block, spec, E, strategy, ctxt, block_root, verify_block_root
+    state, signed_block, spec, E, strategy, ctxt, block_root,
+    verify_block_root, proposal_already_verified,
 ):
     block = signed_block.message
     if ctxt is None:
@@ -224,13 +228,18 @@ def _per_block_processing_inner(
 
     if strategy == BlockSignatureStrategy.VERIFY_BULK:
         verifier = BlockSignatureVerifier(state, spec, E)
-        verifier.include_all_signatures(signed_block, block_root, ctxt)
+        if proposal_already_verified:
+            verifier.include_all_signatures_except_proposal(
+                signed_block.message, ctxt
+            )
+        else:
+            verifier.include_all_signatures(signed_block, block_root, ctxt)
         if not verifier.verify():
             raise BlockProcessingError("bulk signature verification failed")
         # Signatures are done; the per-operation code skips them.
         verify_signatures = False
     elif strategy == BlockSignatureStrategy.VERIFY_INDIVIDUAL:
-        if not sigsets.block_proposal_signature_set(
+        if not proposal_already_verified and not sigsets.block_proposal_signature_set(
             state, signed_block, block_root, spec, E
         ).verify():
             raise BlockProcessingError("invalid proposer signature")

@@ -64,12 +64,13 @@ def get_matching_source_attestations(state, epoch: int, E):
 
 
 def get_matching_target_attestations(state, epoch: int, E):
+    source = get_matching_source_attestations(state, epoch, E)
+    if not source:
+        # At an epoch's first slot the boundary root is not yet recorded in
+        # block_roots; with no attestations there is nothing to match.
+        return []
     root = get_block_root(state, epoch, E)
-    return [
-        a
-        for a in get_matching_source_attestations(state, epoch, E)
-        if a.data.target.root == root
-    ]
+    return [a for a in source if a.data.target.root == root]
 
 
 def get_matching_head_attestations(state, epoch: int, E):

@@ -1,0 +1,20 @@
+"""Block/state storage (beacon_node/store equivalent).
+
+`ItemStore` is the KV trait seam (store/src/lib.rs ItemStore/KeyValueStore);
+`MemoryStore` is the in-memory test backend (store/src/memory_store.rs);
+`SqliteStore` is a host-native persistent backend (stdlib sqlite3 — C under
+the hood — standing in for the reference's LevelDB until the C++ LSM store
+lands). `HotColdDB` splits recent (hot) data from finalized history (cold)
+at the split slot (store/src/hot_cold_store.rs:50-55).
+"""
+
+from .kv import DBColumn, ItemStore, MemoryStore, SqliteStore
+from .hot_cold import HotColdDB
+
+__all__ = [
+    "DBColumn",
+    "ItemStore",
+    "MemoryStore",
+    "SqliteStore",
+    "HotColdDB",
+]

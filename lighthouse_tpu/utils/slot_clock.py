@@ -1,0 +1,60 @@
+"""Slot clocks (common/slot_clock equivalent): wall-clock slots for
+production, a manually-advanced clock for tests
+(system_time_slot_clock.rs / manual_slot_clock.rs)."""
+
+from __future__ import annotations
+
+import time
+
+
+class SlotClock:
+    def now(self) -> int:
+        raise NotImplementedError
+
+    def slot_start_seconds(self, slot: int) -> int:
+        raise NotImplementedError
+
+    def seconds_into_slot(self) -> float:
+        raise NotImplementedError
+
+
+class SystemTimeSlotClock(SlotClock):
+    def __init__(self, genesis_time: int, seconds_per_slot: int):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+
+    def now(self) -> int:
+        t = time.time()
+        if t < self.genesis_time:
+            return 0
+        return int(t - self.genesis_time) // self.seconds_per_slot
+
+    def slot_start_seconds(self, slot: int) -> int:
+        return self.genesis_time + slot * self.seconds_per_slot
+
+    def seconds_into_slot(self) -> float:
+        return (time.time() - self.genesis_time) % self.seconds_per_slot
+
+
+class ManualSlotClock(SlotClock):
+    """Test clock advanced by hand (manual_slot_clock.rs)."""
+
+    def __init__(self, genesis_time: int = 0, seconds_per_slot: int = 12):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+        self._slot = 0
+
+    def now(self) -> int:
+        return self._slot
+
+    def set_slot(self, slot: int):
+        self._slot = slot
+
+    def advance(self, slots: int = 1):
+        self._slot += slots
+
+    def slot_start_seconds(self, slot: int) -> int:
+        return self.genesis_time + slot * self.seconds_per_slot
+
+    def seconds_into_slot(self) -> float:
+        return 0.0

@@ -1,0 +1,174 @@
+"""Proto-array fork choice tests: vote weighting, reorgs, viability,
+proposer boost, pruning, execution invalidation."""
+
+import pytest
+
+from lighthouse_tpu.fork_choice import (
+    ExecutionStatus,
+    ProtoArrayForkChoice,
+)
+
+R = lambda i: bytes([i]) * 32  # noqa: E731
+
+
+def make_fc(justified_epoch=0, finalized_epoch=0):
+    return ProtoArrayForkChoice(
+        finalized_root=R(0),
+        finalized_slot=0,
+        finalized_state_root=R(100),
+        justified_epoch=justified_epoch,
+        finalized_epoch=finalized_epoch,
+    )
+
+
+def add_block(fc, slot, root, parent, je=0, fe=0):
+    fc.on_block(
+        slot=slot,
+        root=root,
+        parent_root=parent,
+        state_root=root,
+        justified_epoch=je,
+        finalized_epoch=fe,
+    )
+
+
+def head(fc, balances, boost_root=b"\x00" * 32, boost=0):
+    return fc.get_head(
+        justified_checkpoint_root=R(0),
+        justified_epoch=0,
+        finalized_epoch=0,
+        justified_state_balances=balances,
+        proposer_boost_root=boost_root,
+        proposer_boost_amount=boost,
+    )
+
+
+def test_single_chain_head():
+    fc = make_fc()
+    add_block(fc, 1, R(1), R(0))
+    add_block(fc, 2, R(2), R(1))
+    assert head(fc, [1, 1]) == R(2)
+
+
+def test_votes_decide_fork():
+    fc = make_fc()
+    add_block(fc, 1, R(1), R(0))
+    add_block(fc, 1, R(2), R(0))  # competing fork
+    # two validators vote for R(1), one for R(2)
+    fc.process_attestation(0, R(1), 1)
+    fc.process_attestation(1, R(1), 1)
+    fc.process_attestation(2, R(2), 1)
+    assert head(fc, [10, 10, 10]) == R(1)
+    # votes move: all to R(2)
+    fc.process_attestation(0, R(2), 2)
+    fc.process_attestation(1, R(2), 2)
+    assert head(fc, [10, 10, 10]) == R(2)
+
+
+def test_balance_weighting():
+    fc = make_fc()
+    add_block(fc, 1, R(1), R(0))
+    add_block(fc, 1, R(2), R(0))
+    fc.process_attestation(0, R(1), 1)  # whale
+    fc.process_attestation(1, R(2), 1)
+    fc.process_attestation(2, R(2), 1)
+    assert head(fc, [100, 10, 10]) == R(1)
+
+
+def test_tie_break_deterministic():
+    fc = make_fc()
+    add_block(fc, 1, R(1), R(0))
+    add_block(fc, 1, R(2), R(0))
+    # no votes: higher root wins
+    assert head(fc, []) == R(2)
+
+
+def test_proposer_boost_flips_head():
+    fc = make_fc()
+    add_block(fc, 1, R(1), R(0))
+    add_block(fc, 1, R(2), R(0))
+    fc.process_attestation(0, R(1), 1)
+    assert head(fc, [10]) == R(1)
+    # boost on R(2) outweighs the 10-unit vote
+    assert head(fc, [10], boost_root=R(2), boost=50) == R(2)
+    # boost expires next call
+    assert head(fc, [10]) == R(1)
+
+
+def test_viability_filter_justification():
+    fc = make_fc()
+    add_block(fc, 1, R(1), R(0), je=0)
+    add_block(fc, 2, R(2), R(1), je=1)  # justified child
+    add_block(fc, 2, R(3), R(1), je=0)  # stale-justification child
+    fc.process_attestation(0, R(3), 1)
+    # with store justified_epoch=1, R(3) is not viable despite the vote
+    got = fc.get_head(
+        justified_checkpoint_root=R(0),
+        justified_epoch=1,
+        finalized_epoch=0,
+        justified_state_balances=[10],
+    )
+    assert got == R(2)
+
+
+def test_equivocation_removes_weight():
+    fc = make_fc()
+    add_block(fc, 1, R(1), R(0))
+    add_block(fc, 1, R(2), R(0))
+    fc.process_attestation(0, R(1), 1)
+    fc.process_attestation(1, R(2), 1)
+    assert head(fc, [100, 10]) == R(1)
+    got = fc.get_head(
+        justified_checkpoint_root=R(0),
+        justified_epoch=0,
+        finalized_epoch=0,
+        justified_state_balances=[100, 10],
+        equivocating_indices={0},
+    )
+    assert got == R(2)
+    # and the slashed weight never comes back
+    assert head(fc, [100, 10]) == R(2)
+
+
+def test_execution_invalidation():
+    fc = make_fc()
+    add_block(fc, 1, R(1), R(0))
+    add_block(fc, 2, R(2), R(1))
+    add_block(fc, 3, R(3), R(2))
+    fc.process_attestation(0, R(3), 1)
+    assert head(fc, [10]) == R(3)
+    fc.proto_array.invalidate_block(R(2))  # invalidates R(2), R(3)
+    assert head(fc, [10]) == R(1)
+
+
+def test_is_descendant():
+    fc = make_fc()
+    add_block(fc, 1, R(1), R(0))
+    add_block(fc, 2, R(2), R(1))
+    add_block(fc, 1, R(3), R(0))
+    pa = fc.proto_array
+    assert pa.is_descendant(R(0), R(2))
+    assert pa.is_descendant(R(1), R(2))
+    assert not pa.is_descendant(R(3), R(2))
+    assert not pa.is_descendant(R(2), R(1))
+
+
+def test_prune():
+    fc = make_fc()
+    fc.proto_array.prune_threshold = 0  # prune aggressively
+    for i in range(1, 6):
+        add_block(fc, i, R(i), R(i - 1))
+    fc.process_attestation(0, R(5), 1)
+    assert head(fc, [10]) == R(5)
+    fc.proto_array.maybe_prune(R(3))
+    assert not fc.contains_block(R(1))
+    assert not fc.contains_block(R(2))
+    assert fc.contains_block(R(3))
+    # head still works on the pruned array (deltas resize on next pass)
+    got = fc.get_head(
+        justified_checkpoint_root=R(3),
+        justified_epoch=0,
+        finalized_epoch=0,
+        justified_state_balances=[10],
+    )
+    assert got == R(5)
