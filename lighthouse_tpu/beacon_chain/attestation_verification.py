@@ -119,9 +119,19 @@ class AttestationVerifier:
         VerifiedUnaggregatedAttestation | AttestationError."""
         prepared = []
         results: list = [None] * len(attestations)
+        seen_in_batch: set[tuple[int, int]] = set()
         for i, att in enumerate(attestations):
             try:
-                prepared.append((i, *self.build_unaggregated(att)))
+                verified, sig_set = self.build_unaggregated(att)
+                # intra-batch dedup: the observed cache only updates after
+                # verification, so duplicates inside one batch need catching
+                key = (att.data.target.epoch, verified.validator_index)
+                if key in seen_in_batch:
+                    raise AttestationError(
+                        "validator already attested this epoch"
+                    )
+                seen_in_batch.add(key)
+                prepared.append((i, verified, sig_set))
             except AttestationError as e:
                 results[i] = e
         sets = [s for (_, _, s) in prepared]

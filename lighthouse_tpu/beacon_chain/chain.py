@@ -45,10 +45,12 @@ class BlockError(ValueError):
 @dataclass
 class GossipVerifiedBlock:
     """Typestate stage 1: header/proposer-signature checked
-    (block_verification.rs:770-1027)."""
+    (block_verification.rs:770-1027). Carries the advanced pre-state so the
+    import stage doesn't recompute it (snapshot-cache handoff)."""
 
     signed_block: object
     block_root: bytes
+    pre_state: object = None
 
 
 @dataclass
@@ -176,7 +178,9 @@ class BeaconChain:
         ).verify():
             raise BlockError("invalid proposer signature")
         self.observed_block_producers.observe(block.slot, block.proposer_index)
-        return GossipVerifiedBlock(signed_block=signed_block, block_root=block_root)
+        return GossipVerifiedBlock(
+            signed_block=signed_block, block_root=block_root, pre_state=parent_state
+        )
 
     def _pre_state_for(self, block):
         """Parent post-state advanced to the block's slot (the
@@ -194,10 +198,12 @@ class BeaconChain:
         import_block): state transition with bulk signature verification,
         store write, fork-choice registration (block + its attestations),
         head recompute."""
+        pre_state = None
         if isinstance(block_input, GossipVerifiedBlock):
             signed_block = block_input.signed_block
             block_root = block_input.block_root
             proposal_verified = True  # checked in verify_block_for_gossip
+            pre_state = block_input.pre_state
         else:
             signed_block = block_input
             block_root = signed_block.message.hash_tree_root()
@@ -214,7 +220,7 @@ class BeaconChain:
                 f"future block: slot {block.slot} > clock {current_slot}"
             )
 
-        state = self._pre_state_for(block)
+        state = pre_state if pre_state is not None else self._pre_state_for(block)
         ctxt = ConsensusContext(block.slot)
         try:
             per_block_processing(
@@ -283,6 +289,21 @@ class BeaconChain:
             if st.slot < finalized_slot and root != self.head_root
             and root != finalized.root
         ]
+        # Canonical finalized ancestors, walked via block parent links (the
+        # proto array may already have pruned these nodes, so it cannot be
+        # asked).
+        canonical: set[bytes] = set()
+        r = finalized.root
+        while True:
+            blk = self._blocks_by_root.get(r)
+            if blk is None:
+                break
+            parent = blk.message.parent_root
+            if parent in canonical or parent == r:
+                break
+            canonical.add(parent)
+            r = parent
+
         migrated = []
         for root in droppable:
             st = self._states.pop(root, None)
@@ -294,9 +315,7 @@ class BeaconChain:
                     blk.message.state_root if blk is not None else st.hash_tree_root()
                 )
                 self.store.delete_state(state_root)
-            if self.fork_choice.proto.proto_array.is_descendant(
-                root, finalized.root
-            ):
+            if root in canonical:
                 # canonical ancestor of the finalized checkpoint → cold DB
                 migrated.append(root)
             else:

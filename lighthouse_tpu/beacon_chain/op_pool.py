@@ -104,41 +104,77 @@ class OperationPool:
                 if source_ok:
                     candidates.append(att)
 
+        # (data_root, attestation) pairs — roots hashed once, not per round
+        keyed = [(att.data.hash_tree_root(), att) for att in candidates]
         chosen: list = []
         covered: set[tuple[bytes, int]] = set()
-        while candidates and len(chosen) < E.MAX_ATTESTATIONS:
-            def gain(att):
-                dr = att.data.hash_tree_root()
+        while keyed and len(chosen) < E.MAX_ATTESTATIONS:
+            def gain(item):
+                dr, att = item
                 return sum(
                     1
                     for i, bit in enumerate(att.aggregation_bits)
                     if bit and (dr, i) not in covered
                 )
 
-            best = max(candidates, key=gain)
+            best = max(keyed, key=gain)
             if gain(best) == 0:
                 break
-            candidates.remove(best)
-            chosen.append(best)
-            dr = best.data.hash_tree_root()
+            keyed.remove(best)
+            dr, att = best
+            chosen.append(att)
             covered.update(
-                (dr, i) for i, bit in enumerate(best.aggregation_bits) if bit
+                (dr, i) for i, bit in enumerate(att.aggregation_bits) if bit
             )
         return chosen
 
     def get_slashings_and_exits(self, state) -> tuple[list, list, list]:
+        """Only operations still applicable on `state` are packed (the
+        reference filters against the state at packing time,
+        operation_pool/src/lib.rs)."""
+        from ..state_processing.accessors import (
+            is_slashable_validator,
+        )
+        from ..types.chain_spec import FAR_FUTURE_EPOCH
+
         E = self.E
-        proposer_slashings = list(self._proposer_slashings.values())[
-            : E.MAX_PROPOSER_SLASHINGS
-        ]
-        attester_slashings = self._attester_slashings[: E.MAX_ATTESTER_SLASHINGS]
-        exits = list(self._voluntary_exits.values())[: E.MAX_VOLUNTARY_EXITS]
+        epoch = get_current_epoch(state, E)
+        n_vals = len(state.validators)
+
+        proposer_slashings = [
+            ps
+            for idx, ps in self._proposer_slashings.items()
+            if idx < n_vals and is_slashable_validator(state.validators[idx], epoch)
+        ][: E.MAX_PROPOSER_SLASHINGS]
+
+        def slashing_applicable(asl):
+            common = set(asl.attestation_1.attesting_indices) & set(
+                asl.attestation_2.attesting_indices
+            )
+            return any(
+                i < n_vals and is_slashable_validator(state.validators[i], epoch)
+                for i in common
+            )
+
+        attester_slashings = [
+            asl for asl in self._attester_slashings if slashing_applicable(asl)
+        ][: E.MAX_ATTESTER_SLASHINGS]
+
+        exits = [
+            ex
+            for idx, ex in self._voluntary_exits.items()
+            if idx < n_vals
+            and state.validators[idx].exit_epoch == FAR_FUTURE_EPOCH
+        ][: E.MAX_VOLUNTARY_EXITS]
         return proposer_slashings, attester_slashings, exits
 
     # -- pruning ------------------------------------------------------------
 
     def prune(self, state):
         """Drop operations no longer includable (prune_all analog)."""
+        from ..state_processing.accessors import is_slashable_validator
+        from ..types.chain_spec import FAR_FUTURE_EPOCH
+
         E = self.E
         previous = get_previous_epoch(state, E)
         stale = [
@@ -149,6 +185,30 @@ class OperationPool:
         for dr in stale:
             self._attestations.pop(dr, None)
             self._attestation_data_slot.pop(dr, None)
+
+        epoch = get_current_epoch(state, E)
+        n_vals = len(state.validators)
+        for idx in [
+            i
+            for i in self._proposer_slashings
+            if i >= n_vals or not is_slashable_validator(state.validators[i], epoch)
+        ]:
+            del self._proposer_slashings[idx]
+        for idx in [
+            i
+            for i, _ in self._voluntary_exits.items()
+            if i >= n_vals or state.validators[i].exit_epoch != FAR_FUTURE_EPOCH
+        ]:
+            del self._voluntary_exits[idx]
+        self._attester_slashings = [
+            asl
+            for asl in self._attester_slashings
+            if any(
+                i < n_vals and is_slashable_validator(state.validators[i], epoch)
+                for i in set(asl.attestation_1.attesting_indices)
+                & set(asl.attestation_2.attesting_indices)
+            )
+        ]
 
     def num_attestations(self) -> int:
         return sum(len(b) for b in self._attestations.values())

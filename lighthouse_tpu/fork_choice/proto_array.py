@@ -319,7 +319,14 @@ class ProtoArrayForkChoice:
         self, validator_index: int, block_root: bytes, target_epoch: int
     ):
         vote = self.votes.setdefault(validator_index, VoteTracker())
-        if target_epoch > vote.next_epoch:
+        # Accept strictly-newer votes, or the first vote ever (epoch-0
+        # attestations must land on a fresh default tracker).
+        is_default = (
+            vote.current_root == b"\x00" * 32
+            and vote.next_root == b"\x00" * 32
+            and vote.next_epoch == 0
+        )
+        if target_epoch > vote.next_epoch or is_default:
             vote.next_root = block_root
             vote.next_epoch = target_epoch
 
