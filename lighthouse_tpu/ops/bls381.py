@@ -1,0 +1,607 @@
+"""Device BLS12-381 arithmetic: batched field/point ops in JAX.
+
+TPU offload point 1 (SURVEY.md §3.2): the per-set scalar multiplications of
+batch signature verification — pubkey scaling by 64-bit random-linear-
+combination scalars, signature scaling, and subgroup checks — vectorized
+over the batch dimension.
+
+Representation: Fq element = 48 limbs of 8 bits (base 2^8), little-endian,
+held in int32. Products of 8-bit limbs are < 2^16 and a 48-term convolution
+stays < 2^22 — comfortably inside int32, the widest integer multiply the
+TPU VPU has (no u64). Montgomery form with R = 2^384:
+
+    mont_mul(a, b) = a·b·R⁻¹ mod p
+      t = conv(a, b)                      (96 limbs, coeffs < 2^22)
+      m = low384(t) · N' mod R            (N' = -p⁻¹ mod R, one low-half conv)
+      u = (t + m·p) / R                   (one conv + shift)
+      conditional subtract p
+
+Fq2 is a pair of Fq lanes; the Jacobian point layer is generic over a field-
+ops record, exactly mirroring the host implementation in crypto/bls12_381/
+curve.py (which doubles as the correctness oracle in tests).
+
+Everything is shaped [batch, ...limbs] and jit/vmap/shard-friendly: scalar
+bits drive a lax.fori_loop of fixed 64/256 trips with branchless selects.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..crypto.bls12_381.fields import P
+
+NLIMB = 48  # 48 × 8-bit limbs = 384 bits
+BASE = 8
+MASK = (1 << BASE) - 1
+R_MONT = 1 << 384
+R2 = (R_MONT * R_MONT) % P
+# N' = -p^{-1} mod R (full-width Montgomery constant)
+NPRIME = (-pow(P, -1, R_MONT)) % R_MONT
+
+AVAILABLE = True
+
+
+def int_to_limbs(x: int, n: int = NLIMB) -> np.ndarray:
+    return np.array([(x >> (BASE * i)) & MASK for i in range(n)], dtype=np.int32)
+
+
+def limbs_to_int(limbs) -> int:
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(v) << (BASE * i) for i, v in enumerate(arr))
+
+
+_P_LIMBS = int_to_limbs(P)
+_NPRIME_LIMBS = int_to_limbs(NPRIME)
+_R2_LIMBS = int_to_limbs(R2)
+_ONE_MONT = int_to_limbs(R_MONT % P)  # 1 in Montgomery form
+# 2^384 - p (for branchless compare/subtract via complement addition)
+_PBAR_LIMBS = int_to_limbs(R_MONT - P)
+_ZERO = np.zeros(NLIMB, dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Limb-vector primitives (shapes [..., NLIMB], int32)
+# ---------------------------------------------------------------------------
+
+
+def _conv_full(a, b):
+    """Full product convolution: [..., N] × [..., N] → [..., 2N-1].
+    Outer product + anti-diagonal sums keeps everything MXU/VPU friendly."""
+    n = a.shape[-1]
+    outer = a[..., :, None] * b[..., None, :]  # [..., N, N] int32 (fits: 2^16)
+    return _antidiagonal_sums(outer, 2 * n - 1)
+
+
+def _conv_low(a, b):
+    """Low-half convolution: product mod 2^(8N) — diagonals 0..N-1 only
+    (carries go strictly upward, so truncating before normalize is exact)."""
+    n = a.shape[-1]
+    outer = a[..., :, None] * b[..., None, :]
+    return _antidiagonal_sums(outer, n)
+
+
+@functools.cache
+def _adiag_matrix(n: int, out_cols: int) -> np.ndarray:
+    """[N*N, out_cols] 0/1 matrix mapping outer-product entries to
+    diagonals (out_cols < 2N-1 truncates to the low diagonals — a mod-2^(8c)
+    product). Cached as numpy — a jnp constant cached across traces would
+    leak tracers."""
+    m = np.zeros((n * n, out_cols), dtype=np.int32)
+    for i in range(n):
+        for j in range(n):
+            if i + j < out_cols:
+                m[i * n + j, i + j] = 1
+    return m
+
+
+def _antidiagonal_sums(outer, out_cols: int):
+    n = outer.shape[-1]
+    flat = outer.reshape(*outer.shape[:-2], n * n)
+    return flat @ jnp.asarray(_adiag_matrix(n, out_cols))  # int32 matmul
+
+
+def _shift_carries(v):
+    """One vectorized carry pass: keep low 8 bits, push carries one limb up
+    (carry out of the last limb must be provably zero at every call site)."""
+    hi = v >> BASE
+    return (v & MASK) + jnp.concatenate(
+        [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
+    )
+
+
+def _resolve_carries(v):
+    """Exact normalization for limbs in [0, 256]: carry-lookahead via an
+    associative (generate, propagate) scan over the limb axis — a chain of
+    255s before a 256 resolves in one log-depth pass instead of O(n)
+    ripple passes."""
+    g = v >= 256  # generates a carry
+    p = v == 255  # propagates an incoming carry
+
+    def combine(a, b):
+        # a is closer to the LSB; carry out of the pair = b.g | (b.p & a.g)
+        return (b[0] | (b[1] & a[0]), a[1] & b[1])
+
+    G, _ = lax.associative_scan(combine, (g, p), axis=-1)
+    carry_in = jnp.concatenate(
+        [jnp.zeros_like(G[..., :1]), G[..., :-1]], axis=-1
+    ).astype(v.dtype)
+    return (v + carry_in) & MASK
+
+
+def _carry_normalize(x, out_len: int, shrink_passes: int = 3):
+    """Canonical 8-bit limbs from bounded coefficients (< 2^22): a few
+    ripple passes shrink limbs into [0, 256], then one exact lookahead
+    resolve."""
+    n = x.shape[-1]
+    if n < out_len:
+        pad = [(0, 0)] * (x.ndim - 1) + [(0, out_len - n)]
+        x = jnp.pad(x, pad)
+    elif n > out_len:
+        raise ValueError("carry overflow: input longer than output")
+    v = x
+    for _ in range(shrink_passes):
+        v = _shift_carries(v)
+    return _resolve_carries(v)
+
+
+def _cond_sub_p(x):
+    """x normalized, value in [0, 2p) → x mod p, branchless: s = x + (2^384
+    - p); bit 384 of s is set iff x ≥ p, and then s's low 384 bits are x-p."""
+    s = x + jnp.asarray(_PBAR_LIMBS)  # limbs ≤ 510
+    s = _carry_normalize(s, NLIMB + 1, shrink_passes=2)
+    ge = s[..., NLIMB] > 0
+    return jnp.where(ge[..., None], s[..., :NLIMB], x)
+
+
+def mont_mul(a, b):
+    """Montgomery product a·b·R⁻¹ mod p. Inputs/outputs: [..., 48] int32,
+    limbs < 2^8, value < p."""
+    t = _conv_full(a, b)  # [..., 95], coeffs < 48·2^16 < 2^22
+    t = _carry_normalize(t, 2 * NLIMB)  # 96 normalized limbs
+    t_lo = t[..., :NLIMB]
+    m = _conv_low(t_lo, jnp.asarray(_NPRIME_LIMBS))  # mod R: low half only
+    m = _carry_normalize(m, NLIMB)
+    mp = _carry_normalize(
+        _conv_full(m, jnp.asarray(_P_LIMBS)), 2 * NLIMB
+    )
+    # t + m·p < 2Rp < 2^767: fits 96 limbs; low 48 limbs are zero by
+    # construction of m, so /R is a limb shift.
+    s = _carry_normalize(t + mp, 2 * NLIMB, shrink_passes=2)
+    u = s[..., NLIMB:]
+    return _cond_sub_p(u)
+
+
+def to_mont(x_limbs):
+    return mont_mul(x_limbs, jnp.asarray(_R2_LIMBS))
+
+
+def from_mont(x_limbs):
+    one = jnp.zeros_like(x_limbs).at[..., 0].set(1)
+    return mont_mul(x_limbs, one)
+
+
+def mod_add(a, b):
+    v = _carry_normalize(a + b, NLIMB, shrink_passes=2)  # < 2p < 2^384
+    return _cond_sub_p(v)
+
+
+def mod_sub(a, b):
+    """a - b mod p via complement: a + (2^384 - b) + p - 2^384; the 2^384
+    bit of the normalized sum is always set (a-b+p ≥ 0), drop it."""
+    comp_b = MASK - b  # 2^384 - b = ~b + 1 (limbwise complement, +1 below)
+    v = a + comp_b + jnp.asarray(_P_LIMBS)
+    v = v.at[..., 0].add(1)
+    v = _carry_normalize(v, NLIMB + 1, shrink_passes=2)
+    # v = (a - b + p) + 2^384, and a-b+p < 2p < 2^384 ⇒ limb 48 == 1
+    return _cond_sub_p(v[..., :NLIMB])
+
+
+# ---------------------------------------------------------------------------
+# Field-ops records (device analog of crypto/bls12_381/curve.py FieldOps)
+# ---------------------------------------------------------------------------
+
+
+class DevFq:
+    """Fq ops over [..., 48] limb arrays (values in Montgomery form)."""
+
+    @staticmethod
+    def add(a, b):
+        return mod_add(a, b)
+
+    @staticmethod
+    def sub(a, b):
+        return mod_sub(a, b)
+
+    @staticmethod
+    def mul(a, b):
+        return mont_mul(a, b)
+
+    @staticmethod
+    def sqr(a):
+        return mont_mul(a, a)
+
+    @staticmethod
+    def neg(a):
+        zero = jnp.zeros_like(a)
+        return mod_sub(zero, a)
+
+    @staticmethod
+    def zeros(shape):
+        return jnp.zeros((*shape, NLIMB), dtype=jnp.int32)
+
+    @staticmethod
+    def is_zero(a):
+        return jnp.all(a == 0, axis=-1)
+
+    @staticmethod
+    def select(c, a, b):
+        """c: [...] bool — where(c, a, b) broadcast over limbs."""
+        return jnp.where(c[..., None], a, b)
+
+
+class DevFq2:
+    """Fq2 ops over [..., 2, 48] limb arrays (c0 + c1·u, u² = -1)."""
+
+    @staticmethod
+    def add(a, b):
+        return jnp.stack(
+            [mod_add(a[..., 0, :], b[..., 0, :]), mod_add(a[..., 1, :], b[..., 1, :])],
+            axis=-2,
+        )
+
+    @staticmethod
+    def sub(a, b):
+        return jnp.stack(
+            [mod_sub(a[..., 0, :], b[..., 0, :]), mod_sub(a[..., 1, :], b[..., 1, :])],
+            axis=-2,
+        )
+
+    @staticmethod
+    def mul(a, b):
+        a0, a1 = a[..., 0, :], a[..., 1, :]
+        b0, b1 = b[..., 0, :], b[..., 1, :]
+        t0 = mont_mul(a0, b0)
+        t1 = mont_mul(a1, b1)
+        c0 = mod_sub(t0, t1)
+        cross = mont_mul(mod_add(a0, a1), mod_add(b0, b1))
+        c1 = mod_sub(mod_sub(cross, t0), t1)
+        return jnp.stack([c0, c1], axis=-2)
+
+    @staticmethod
+    def sqr(a):
+        return DevFq2.mul(a, a)
+
+    @staticmethod
+    def neg(a):
+        zero = jnp.zeros_like(a)
+        return DevFq2.sub(zero, a)
+
+    @staticmethod
+    def zeros(shape):
+        return jnp.zeros((*shape, 2, NLIMB), dtype=jnp.int32)
+
+    @staticmethod
+    def is_zero(a):
+        return jnp.all(a == 0, axis=(-1, -2))
+
+    @staticmethod
+    def select(c, a, b):
+        return jnp.where(c[..., None, None], a, b)
+
+
+# ---------------------------------------------------------------------------
+# Generic Jacobian point ops (branchless; infinity encoded as Z == 0)
+# ---------------------------------------------------------------------------
+
+
+def pt_double(F, pt):
+    x, y, z = pt
+    a = F.sqr(x)
+    b = F.sqr(y)
+    c = F.sqr(b)
+    d = F.sub(F.sub(F.sqr(F.add(x, b)), a), c)
+    d = F.add(d, d)
+    e = F.add(F.add(a, a), a)
+    f = F.sqr(e)
+    x3 = F.sub(f, F.add(d, d))
+    c8 = F.add(F.add(c, c), F.add(c, c))
+    c8 = F.add(c8, c8)
+    y3 = F.sub(F.mul(e, F.sub(d, x3)), c8)
+    z3 = F.mul(F.add(y, y), z)
+    return (x3, y3, z3)
+
+
+def pt_add(F, p1, p2):
+    """Branchless Jacobian add handling infinity and doubling cases via
+    selects (device code cannot branch per lane)."""
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    inf1 = F.is_zero(z1)
+    inf2 = F.is_zero(z2)
+    z1z1 = F.sqr(z1)
+    z2z2 = F.sqr(z2)
+    u1 = F.mul(x1, z2z2)
+    u2 = F.mul(x2, z1z1)
+    s1 = F.mul(y1, F.mul(z2z2, z2))
+    s2 = F.mul(y2, F.mul(z1z1, z1))
+    h = F.sub(u2, u1)
+    r = F.sub(s2, s1)
+    same_x = F.is_zero(h)
+    same_y = F.is_zero(r)
+    is_double = same_x & same_y & ~inf1 & ~inf2
+    is_inf_result = same_x & ~same_y & ~inf1 & ~inf2
+
+    i = F.sqr(F.add(h, h))
+    j = F.mul(h, i)
+    r2 = F.add(r, r)
+    v = F.mul(u1, i)
+    x3 = F.sub(F.sub(F.sqr(r2), j), F.add(v, v))
+    s1j = F.mul(s1, j)
+    y3 = F.sub(F.mul(r2, F.sub(v, x3)), F.add(s1j, s1j))
+    z3 = F.mul(F.mul(z1, z2), h)
+    z3 = F.add(z3, z3)
+
+    dx, dy, dz = pt_double(F, p1)
+
+    x3 = F.select(is_double, dx, x3)
+    y3 = F.select(is_double, dy, y3)
+    z3 = F.select(is_double, dz, z3)
+
+    zero = F.zeros(z3.shape[: z3.ndim - (1 if F is DevFq else 2)])
+    z3 = F.select(is_inf_result, zero, z3)
+
+    # infinity inputs pass the other operand through
+    x3 = F.select(inf1, x2, x3)
+    y3 = F.select(inf1, y2, y3)
+    z3 = F.select(inf1, z2, z3)
+    x3 = F.select(inf2 & ~inf1, x1, x3)
+    y3 = F.select(inf2 & ~inf1, y1, y3)
+    z3 = F.select(inf2 & ~inf1, z1, z3)
+    return (x3, y3, z3)
+
+
+def pt_scalar_mul(F, pt, scalar_bits):
+    """Batch double-and-add: scalar_bits [batch, nbits] int32 (LSB first),
+    pt = tuple of [batch, ...] coords. Fixed trip count, branchless."""
+    nbits = scalar_bits.shape[-1]
+
+    def body(i, carry):
+        acc, addend = carry
+        bit = scalar_bits[..., i]
+        added = pt_add(F, acc, addend)
+        acc = tuple(
+            F.select(bit.astype(bool), a_new, a_old)
+            for a_new, a_old in zip(added, acc)
+        )
+        addend = pt_double(F, addend)
+        return (acc, addend)
+
+    batch_shape = scalar_bits.shape[:-1]
+    zero = F.zeros(batch_shape)
+    one_mont = jnp.broadcast_to(
+        jnp.asarray(_ONE_MONT), (*batch_shape, NLIMB)
+    ).astype(jnp.int32)
+    if F is DevFq2:
+        one = jnp.stack([one_mont, jnp.zeros_like(one_mont)], axis=-2)
+    else:
+        one = one_mont
+    inf = (one, one, zero)
+    acc, _ = lax.fori_loop(0, nbits, body, (inf, pt))
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device conversion
+# ---------------------------------------------------------------------------
+
+
+def fq_to_device(values: list[int]) -> np.ndarray:
+    """List of field ints → [batch, 48] Montgomery limb array."""
+    return np.stack(
+        [int_to_limbs(v * R_MONT % P) for v in values]
+    ).astype(np.int32)
+
+
+def fq_from_device(arr) -> list[int]:
+    out = []
+    host = np.asarray(arr)
+    for row in host.reshape(-1, NLIMB):
+        out.append(limbs_to_int(row) * pow(R_MONT, -1, P) % P)
+    return out
+
+
+def g1_points_to_device(points) -> tuple:
+    """Host Jacobian G1 points (int tuples) → device limb arrays [n,48]×3."""
+    xs, ys, zs = [], [], []
+    for (x, y, z) in points:
+        xs.append(x)
+        ys.append(y)
+        zs.append(z)
+    return (
+        jnp.asarray(fq_to_device(xs)),
+        jnp.asarray(fq_to_device(ys)),
+        jnp.asarray(fq_to_device(zs)),
+    )
+
+
+def g1_points_from_device(pt) -> list:
+    xs = fq_from_device(pt[0])
+    ys = fq_from_device(pt[1])
+    zs = fq_from_device(pt[2])
+    return list(zip(xs, ys, zs))
+
+
+def g2_points_to_device(points) -> tuple:
+    coords = [[], [], []]
+    for p in points:
+        for k in range(3):
+            coords[k].append(p[k])
+    out = []
+    for lane in coords:
+        c0 = fq_to_device([c[0] for c in lane])
+        c1 = fq_to_device([c[1] for c in lane])
+        out.append(jnp.asarray(np.stack([c0, c1], axis=1)))
+    return tuple(out)
+
+
+def g2_points_from_device(pt) -> list:
+    out = []
+    host = [np.asarray(c) for c in pt]
+    n = host[0].shape[0]
+    rinv = pow(R_MONT, -1, P)
+    for i in range(n):
+        coords = []
+        for k in range(3):
+            c0 = limbs_to_int(host[k][i, 0]) * rinv % P
+            c1 = limbs_to_int(host[k][i, 1]) * rinv % P
+            coords.append((c0, c1))
+        out.append(tuple(coords))
+    return out
+
+
+def scalars_to_bits(scalars: list[int], nbits: int) -> np.ndarray:
+    out = np.zeros((len(scalars), nbits), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        for b in range(nbits):
+            out[i, b] = (s >> b) & 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Jitted batch kernels
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def batch_g1_scalar_mul(xs, ys, zs, bits):
+    """[n] G1 points × [n, nbits] scalars → [n] G1 points (Jacobian)."""
+    return pt_scalar_mul(DevFq, (xs, ys, zs), bits)
+
+
+@jax.jit
+def batch_g2_scalar_mul(xs, ys, zs, bits):
+    return pt_scalar_mul(DevFq2, (xs, ys, zs), bits)
+
+
+@jax.jit
+def g1_sum_reduce(xs, ys, zs):
+    """Tree-reduce a batch of G1 points to a single sum (log2 n adds)."""
+    pt = (xs, ys, zs)
+    n = xs.shape[0]
+    while n > 1:
+        half = n // 2
+        lo = tuple(c[:half] for c in pt)
+        hi = tuple(c[half : half * 2] for c in pt)
+        merged = pt_add(DevFq, lo, hi)
+        if n % 2:
+            pt = tuple(
+                jnp.concatenate([m, c[-1:]], axis=0)
+                for m, c in zip(merged, pt)
+            )
+            n = half + 1
+        else:
+            pt = merged
+            n = half
+    return pt
+
+
+# ---------------------------------------------------------------------------
+# Device-backed verify_signature_sets (the `tpu` backend's batch path)
+# ---------------------------------------------------------------------------
+
+
+def verify_signature_sets_device(sets, rng=None) -> bool:
+    """RLC batch verification with the G1/G2 scalar multiplications on
+    device; subgroup checks and the final multi-pairing remain host-side
+    until the pairing kernel lands. Falls back to plain host verification
+    for tiny batches (dispatch overhead dominates)."""
+    import secrets as _secrets
+
+    from ..crypto import bls
+    from ..crypto.bls12_381 import (
+        FQ,
+        FQ2,
+        G1_GEN,
+        g2_in_subgroup,
+        hash_to_g2,
+        inf,
+        is_inf,
+        pairing_check,
+        pt_add as host_pt_add,
+        pt_neg,
+    )
+    from ..crypto.bls12_381.fields import R as CURVE_R
+
+    sets = list(sets)
+    if len(sets) < 8:
+        return bls._BACKENDS["host"].verify_signature_sets(sets, rng)
+
+    rand = rng if rng is not None else _secrets.SystemRandom()
+    sig_points = []
+    agg_pks = []
+    scalars = []
+    messages = []
+    for s in sets:
+        try:
+            if s.signature.is_infinity():
+                return False
+            sig_pt = s.signature.point()
+            if not g2_in_subgroup(sig_pt):
+                return False
+            pk_pts = [pk.point() for pk in s.pubkeys]
+        except (bls.BlsError, ValueError):
+            return False
+        if not pk_pts:
+            return False
+        agg = inf(FQ)
+        for p in pk_pts:
+            agg = host_pt_add(FQ, agg, p)
+        r = 0
+        while r == 0:
+            r = rand.getrandbits(bls.RAND_BITS)
+        sig_points.append(sig_pt)
+        agg_pks.append(agg)
+        scalars.append(r)
+        messages.append(s.message)
+
+    n = len(sets)
+    # Pad to a power-of-two bucket so jit caches few shapes (the reference
+    # batches gossip work in fixed chunks of 64 for the same reason,
+    # beacon_processor/src/lib.rs:200). Padding scalar 0 → infinity result,
+    # sliced off below.
+    bucket = 8
+    while bucket < n:
+        bucket *= 2
+    pad = bucket - n
+    scalars_p = scalars + [0] * pad
+    pts_pad_g1 = agg_pks + [agg_pks[0]] * pad
+    pts_pad_g2 = sig_points + [sig_points[0]] * pad
+
+    bits = jnp.asarray(scalars_to_bits(scalars_p, bls.RAND_BITS))
+    # G1: scale each aggregated pubkey by its scalar on device
+    g1x, g1y, g1z = g1_points_to_device(pts_pad_g1)
+    scaled_g1 = batch_g1_scalar_mul(g1x, g1y, g1z, bits)
+    scaled_pks = g1_points_from_device(scaled_g1)[:n]
+    # G2: scale each signature, reduce to the aggregate on device
+    g2x, g2y, g2z = g2_points_to_device(pts_pad_g2)
+    scaled_g2 = batch_g2_scalar_mul(g2x, g2y, g2z, bits)
+    scaled_sigs = g2_points_from_device(scaled_g2)[:n]
+
+    agg_sig = inf(FQ2)
+    for sp in scaled_sigs:
+        agg_sig = host_pt_add(FQ2, agg_sig, sp)
+
+    by_message: dict[bytes, object] = {}
+    for msg, spk in zip(messages, scaled_pks):
+        prev = by_message.get(msg)
+        by_message[msg] = spk if prev is None else host_pt_add(FQ, prev, spk)
+
+    pairs = [(pt_neg(FQ, G1_GEN), agg_sig)]
+    for msg, pk_pt in by_message.items():
+        pairs.append((pk_pt, hash_to_g2(msg)))
+    return pairing_check(pairs)

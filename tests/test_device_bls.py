@@ -1,0 +1,118 @@
+"""Device BLS limb arithmetic vs host bigint oracle.
+
+Field ops run in the default suite (fast compiles); batch point ops and the
+full device batch-verify are marked slow (minutes of XLA-CPU compile on
+first run; the repo-local persistent cache amortizes them)."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.bls12_381 import (
+    FQ,
+    FQ2,
+    G1_GEN,
+    G2_GEN,
+    pt_add,
+    pt_eq,
+    pt_mul,
+)
+from lighthouse_tpu.crypto.bls12_381.fields import P
+from lighthouse_tpu.ops import bls381 as D
+
+
+def test_limb_roundtrip():
+    rng = random.Random(0)
+    xs = [0, 1, P - 1] + [rng.randrange(P) for _ in range(5)]
+    arr = D.fq_to_device(xs)
+    assert D.fq_from_device(arr) == xs
+
+
+def test_field_ops_vs_bigint():
+    rng = random.Random(1)
+    xs = [rng.randrange(P) for _ in range(16)]
+    ys = [rng.randrange(P) for _ in range(16)]
+    ax, ay = jnp.asarray(D.fq_to_device(xs)), jnp.asarray(D.fq_to_device(ys))
+    assert D.fq_from_device(D.mont_mul(ax, ay)) == [
+        (x * y) % P for x, y in zip(xs, ys)
+    ]
+    assert D.fq_from_device(D.mod_add(ax, ay)) == [
+        (x + y) % P for x, y in zip(xs, ys)
+    ]
+    assert D.fq_from_device(D.mod_sub(ax, ay)) == [
+        (x - y) % P for x, y in zip(xs, ys)
+    ]
+
+
+def test_field_edge_cases():
+    edge = [0, P - 1, 1, P - 1, 12345, 0x123456789ABCDEF]
+    e = jnp.asarray(D.fq_to_device(edge))
+    assert D.fq_from_device(D.mod_sub(e, e)) == [0] * 6
+    assert D.fq_from_device(D.mod_add(e, e)) == [(v * 2) % P for v in edge]
+    assert D.fq_from_device(D.mont_mul(e, e)) == [(v * v) % P for v in edge]
+
+
+def test_carry_cascade_regression():
+    """Values engineered to produce long 255-chains (the lookahead resolve
+    path); ripple passes alone would mis-normalize these."""
+    vals = [((1 << 380) - 1) % P, P - 1, ((255 << 376) + 255) % P]
+    a = jnp.asarray(D.fq_to_device(vals))
+    one = jnp.asarray(D.fq_to_device([1, 1, 1]))
+    got = D.fq_from_device(D.mont_mul(a, one))
+    assert got == vals
+
+
+@pytest.mark.slow
+def test_g1_batch_scalar_mul():
+    rng = random.Random(2)
+    pts = [pt_mul(FQ, G1_GEN, rng.randrange(1, 10**9)) for _ in range(8)]
+    scalars = [rng.getrandbits(64) for _ in range(8)]
+    xs, ys, zs = D.g1_points_to_device(pts)
+    bits = jnp.asarray(D.scalars_to_bits(scalars, 64))
+    got = D.g1_points_from_device(D.batch_g1_scalar_mul(xs, ys, zs, bits))
+    for g, p, s in zip(got, pts, scalars):
+        assert pt_eq(FQ, g, pt_mul(FQ, p, s))
+
+
+@pytest.mark.slow
+def test_g1_sum_reduce():
+    rng = random.Random(3)
+    pts = [pt_mul(FQ, G1_GEN, rng.randrange(1, 10**9)) for _ in range(8)]
+    xs, ys, zs = D.g1_points_to_device(pts)
+    got = D.g1_points_from_device(D.g1_sum_reduce(xs, ys, zs))[0]
+    want = pts[0]
+    for p in pts[1:]:
+        want = pt_add(FQ, want, p)
+    assert pt_eq(FQ, got, want)
+
+
+@pytest.mark.slow
+def test_g2_batch_scalar_mul():
+    rng = random.Random(4)
+    pts = [pt_mul(FQ2, G2_GEN, rng.randrange(1, 10**9)) for _ in range(8)]
+    scalars = [rng.getrandbits(64) for _ in range(8)]
+    xs, ys, zs = D.g2_points_to_device(pts)
+    bits = jnp.asarray(D.scalars_to_bits(scalars, 64))
+    got = D.g2_points_from_device(D.batch_g2_scalar_mul(xs, ys, zs, bits))
+    for g, p, s in zip(got, pts, scalars):
+        assert pt_eq(FQ2, g, pt_mul(FQ2, p, s))
+
+
+@pytest.mark.slow
+def test_device_verify_signature_sets():
+    import hashlib
+
+    from lighthouse_tpu.crypto import bls
+
+    bls.set_backend("host")
+    kps = bls.interop_keypairs(8)
+    msg = hashlib.sha256(b"device batch").digest()
+    sets = [bls.SignatureSet.single(kp.sk.sign(msg), kp.pk, msg) for kp in kps]
+    assert D.verify_signature_sets_device(sets, random.Random(5))
+    bad = list(sets)
+    bad[3] = bls.SignatureSet.single(
+        sets[4].signature, sets[3].pubkeys[0], sets[3].message
+    )
+    assert not D.verify_signature_sets_device(bad, random.Random(6))
