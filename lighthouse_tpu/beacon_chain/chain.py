@@ -42,6 +42,10 @@ class BlockError(ValueError):
     pass
 
 
+class BeaconChainError(RuntimeError):
+    pass
+
+
 @dataclass
 class GossipVerifiedBlock:
     """Typestate stage 1: header/proposer-signature checked
@@ -110,11 +114,83 @@ class BeaconChain:
         return self._blocks_by_root.get(self.head_root)
 
     def recompute_head(self):
-        """canonical_head.rs:473 recompute_head_at_current_slot."""
+        """canonical_head.rs:473 recompute_head_at_current_slot.
+
+        If the new head's state fell out of the snapshot cache, reload it
+        from the store instead of silently keeping the stale head."""
         new_head = self.fork_choice.get_head(self.slot_clock.now())
-        if new_head != self.head_root and new_head in self._states:
+        if new_head != self.head_root:
+            if new_head not in self._states:
+                state = self._load_state_for_block(new_head)
+                if state is None:
+                    raise BeaconChainError(
+                        f"fork choice head {new_head.hex()} has no state in "
+                        "cache or store"
+                    )
+                self._states[new_head] = state
             self.head_root = new_head
         return self.head_root
+
+    def _load_state_for_block(self, block_root: bytes):
+        """Fetch a block's post-state: hot/cold store by advertised state
+        root, falling back to replaying blocks from the nearest ancestor
+        whose state survives (the reference's BlockReplayer,
+        state_processing/src/block_replayer.rs)."""
+        signed = self._signed_block(block_root)
+        if signed is None:
+            return None
+        state = self.store.get_state(signed.message.state_root)
+        if state is not None:
+            return state
+        return self._replay_state(block_root)
+
+    def _signed_block(self, block_root: bytes):
+        blk = self._blocks_by_root.get(block_root)
+        if blk is not None:
+            return blk
+        return self.store.get_block(block_root)
+
+    def _replay_state(self, block_root: bytes):
+        """Walk ancestors to the nearest retrievable state, then re-apply
+        the intervening blocks (signatures already verified at first import;
+        the state-root check re-anchors every replayed block)."""
+        from ..state_processing.per_block import (
+            BlockSignatureStrategy,
+            per_block_processing,
+        )
+
+        chain = []
+        r = block_root
+        base = None
+        while True:
+            if r in self._states:
+                base = self._states[r].copy()
+                break
+            signed = self._signed_block(r)
+            if signed is None:
+                return None
+            st = self.store.get_state(signed.message.state_root)
+            if st is not None:
+                base = st.copy()
+                break
+            chain.append(signed)
+            parent = signed.message.parent_root
+            if parent == r:
+                return None
+            r = parent
+        for signed in reversed(chain):
+            block = signed.message
+            while base.slot < block.slot:
+                per_slot_processing(base, self.spec, self.E)
+            per_block_processing(
+                base,
+                signed,
+                self.spec,
+                self.E,
+                strategy=BlockSignatureStrategy.NO_VERIFICATION,
+                verify_block_root=True,
+            )
+        return base
 
     @property
     def finalized_checkpoint(self):

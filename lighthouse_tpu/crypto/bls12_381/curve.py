@@ -223,6 +223,10 @@ G2_GEN = (
 H1 = (X - 1) ** 2 // 3
 H2 = (X**8 - 4 * X**7 + 5 * X**6 - 4 * X**4 + 6 * X**3 - 4 * X**2 - 4 * X + 13) // 9
 assert H1 == 0x396C8C005555E1568C00AAAB0000AAAB
+# RFC 9380 §8.8.2 effective G2 cofactor (Budroni–Pintore): h_eff = 3(z²−1)·h2
+# with z = -X. Using h_eff (not h2) in hash-to-curve is REQUIRED for wire
+# compatibility — [h_eff]Q = [3(z²−1) mod r]·[h2]Q, a different G2 point.
+H2_EFF = 3 * (X * X - 1) * H2
 
 # ---------------------------------------------------------------------------
 # Subgroup / membership checks
@@ -343,8 +347,13 @@ def g2_from_bytes(data: bytes):
 
 
 def g2_clear_cofactor(pt):
-    """Map a point on E2 into the r-order subgroup G2 (multiply by h2)."""
-    return pt_mul(FQ2, pt, H2)
+    """Map a point on E2 into the r-order subgroup G2.
+
+    Multiplies by the RFC 9380 effective cofactor h_eff = 3(z²−1)·h2, which
+    is what BLS12381G2_XMD:SHA-256_SSWU_RO_ (and hence blst / the reference's
+    crypto/bls/src/impls/blst.rs hashing) uses — NOT the plain cofactor h2.
+    """
+    return pt_mul(FQ2, pt, H2_EFF)
 
 
 def g1_clear_cofactor(pt):

@@ -9,11 +9,14 @@ isogenous curve E': y² = x³ + 240u·x + 1012(1+u) with Z = -(2+u), then a
 The 3-isogeny is NOT a memorized constant table: E' has a unique rational
 3-isogeny kernel over Fq2 (x0 = -6+6u, the only Fq2-rational root of the
 3-division polynomial — derived via Vélu's formulas; see tests). Vélu's maps
-land on y² = x³ + 4ξ·3⁶, and composing with (x,y) ↦ (x/9, y/27) gives E2
-exactly. The resulting map may differ from the RFC's normalization by an
-automorphism of E2, which preserves every security/distribution property and
-all in-framework signature validity; exact RFC vector parity is tracked as
-future work (swap this map for the RFC coefficient table).
+land on y² = x³ + 4ξ·3⁶, and composing with (x,y) ↦ (x/9, -y/27) gives E2
+with exactly RFC 9380 Appendix E.3's normalization: expanding
+x_num = (x·d² + t·d + u)/9 over d = x - x0 reproduces the RFC's k_(1,i)
+table coefficient-for-coefficient (k_(1,3) = 1/9 mod p, x_den = d²,
+y_den = d³, y_num leading coefficient = -1/27 mod p — note the NEGATED y,
+RFC k_(3,3) ≡ -1/27). tests/test_hash_to_curve.py pins the expansion against
+the RFC constants and the BLS12381G2_XMD:SHA-256_SSWU_RO_ known-answer
+vectors.
 """
 
 from __future__ import annotations
@@ -59,7 +62,9 @@ def _isogeny_to_e2(x, y):
         F.f2_sub(F.F2_ONE, F.f2_mul(_T, d_inv2)),
         F.f2_mul(F.f2_mul_scalar(_U, 2), d_inv3),
     )
-    phi_y = F.f2_mul(F.f2_mul(y, deriv), _INV27)
+    # RFC 9380 E.3 normalization: y-map is NEGATED relative to the plain
+    # Vélu/27 composition (k_(3,3) = -1/27 mod p).
+    phi_y = F.f2_neg(F.f2_mul(F.f2_mul(y, deriv), _INV27))
     return phi_x, phi_y
 
 

@@ -97,11 +97,19 @@ class ForkChoice:
     # ------------------------------------------------------------------ ticks
 
     def on_tick(self, slot: int):
-        """Advance wall-clock slot; reset proposer boost at slot start
-        (fork_choice.rs update_time/on_tick_per_slot)."""
+        """Advance wall-clock slot; reset proposer boost at slot start and,
+        on epoch boundaries, promote the unrealized checkpoints to the store
+        (spec on_tick_per_slot) — without this, justification can lag
+        indefinitely when no new blocks arrive."""
         while self.store.current_slot < slot:
             self.store.current_slot += 1
             self.store.proposer_boost_root = b"\x00" * 32
+            if self.store.current_slot % self.E.SLOTS_PER_EPOCH == 0:
+                self._update_checkpoints(
+                    self.store.unrealized_justified_checkpoint,
+                    self.store.unrealized_finalized_checkpoint,
+                    state=None,
+                )
 
     # ------------------------------------------------------------------ block
 
@@ -173,17 +181,26 @@ class ForkChoice:
             unrealized_finalized_epoch=unrealized_f.epoch,
         )
 
-    def _update_checkpoints(self, justified: Checkpoint, finalized: Checkpoint, state):
+    def _update_checkpoints(
+        self, justified: Checkpoint, finalized: Checkpoint, state=None
+    ):
         if justified.epoch > self.store.justified_checkpoint.epoch:
             self.store.justified_checkpoint = justified
+            # Vote weights must come from the justified state's effective
+            # balances (spec). The provider serves the actual justified
+            # state; the importing block's post-state is a fallback whose
+            # active set matches at the justified epoch in all but deep-reorg
+            # edge cases; with neither, keep the previous balances (tick-path
+            # promotion with a cold cache) — refreshed on next block import.
             balance_state = None
             if self.state_provider is not None:
                 balance_state = self.state_provider(justified.root)
             if balance_state is None:
                 balance_state = state
-            self._justified_balances = _active_balances(
-                balance_state, self.E, at_epoch=justified.epoch
-            )
+            if balance_state is not None:
+                self._justified_balances = _active_balances(
+                    balance_state, self.E, at_epoch=justified.epoch
+                )
         if finalized.epoch > self.store.finalized_checkpoint.epoch:
             self.store.finalized_checkpoint = finalized
             self.proto.proto_array.maybe_prune(finalized.root)
@@ -254,6 +271,18 @@ class ForkChoice:
         head_slot = self.proto.block_slot(data.beacon_block_root)
         if head_slot is not None and head_slot > data.slot:
             raise InvalidAttestation("attestation to a future block")
+        # FFG/LMD consistency: the target must be the checkpoint block of the
+        # head block's chain at target.epoch (spec validate_on_attestation;
+        # fork_choice.rs target-root ancestor check).
+        target_slot = compute_start_slot_at_epoch(data.target.epoch, self.E)
+        checkpoint_block = self.proto.proto_array.ancestor_at_slot(
+            data.beacon_block_root, target_slot
+        )
+        if checkpoint_block != data.target.root:
+            raise InvalidAttestation(
+                "attestation target is inconsistent with the head block's "
+                "chain at the target epoch"
+            )
         if not is_from_block and self.store.current_slot < data.slot + 1:
             raise InvalidAttestation("attestation from the future")
 

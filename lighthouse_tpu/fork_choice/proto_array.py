@@ -219,6 +219,18 @@ class ProtoArray:
 
     # ------------------------------------------------------------------ misc
 
+    def ancestor_at_slot(self, root: bytes, slot: int) -> bytes | None:
+        """Spec get_ancestor: the block in `root`'s chain at or before `slot`
+        (walks parents; returns None if root is unknown or the walk leaves
+        the array)."""
+        i = self.indices.get(root)
+        while i is not None:
+            node = self.nodes[i]
+            if node.slot <= slot:
+                return node.root
+            i = node.parent
+        return None
+
     def is_descendant(self, ancestor_root: bytes, descendant_root: bytes) -> bool:
         ai = self.indices.get(ancestor_root)
         di = self.indices.get(descendant_root)

@@ -172,3 +172,77 @@ def test_prune():
         justified_state_balances=[10],
     )
     assert got == R(5)
+
+
+# ---------------------------------------------------------------------------
+# ForkChoice wrapper validation (spec validate_on_attestation)
+# ---------------------------------------------------------------------------
+
+from lighthouse_tpu.fork_choice.fork_choice import (  # noqa: E402
+    Checkpoint as FcCheckpoint,
+    ForkChoice,
+    ForkChoiceStore,
+    InvalidAttestation,
+)
+from lighthouse_tpu.types.chain_spec import minimal_spec  # noqa: E402
+from lighthouse_tpu.types.containers import build_types  # noqa: E402
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec  # noqa: E402
+
+
+def make_wrapper(current_slot=0):
+    cp = FcCheckpoint(epoch=0, root=R(0))
+    store = ForkChoiceStore(
+        current_slot=current_slot,
+        justified_checkpoint=cp,
+        finalized_checkpoint=cp,
+        unrealized_justified_checkpoint=cp,
+        unrealized_finalized_checkpoint=cp,
+    )
+    return ForkChoice(store, make_fc(), minimal_spec(), MinimalEthSpec)
+
+
+def _attestation(T, slot, head_root, target_epoch, target_root, indices=(0,)):
+    return T.IndexedAttestation(
+        attesting_indices=list(indices),
+        data=T.AttestationData(
+            slot=slot,
+            index=0,
+            beacon_block_root=head_root,
+            source=T.Checkpoint(epoch=0, root=R(0)),
+            target=T.Checkpoint(epoch=target_epoch, root=target_root),
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def test_on_attestation_target_chain_consistency():
+    """An attestation whose target root is not the checkpoint block of the
+    head block's chain at target.epoch must be rejected (ADVICE r1)."""
+    T = build_types(MinimalEthSpec)
+    fc = make_wrapper(current_slot=MinimalEthSpec.SLOTS_PER_EPOCH + 2)
+    # epoch-0 chain: R0 (genesis anchor) <- R1; epoch-1 blocks: R2 on R1,
+    # and a fork F3 directly on R0 (its epoch-1 checkpoint block is R0).
+    add_block(fc.proto, 1, R(1), R(0))
+    e1 = MinimalEthSpec.SLOTS_PER_EPOCH
+    add_block(fc.proto, e1, R(2), R(1))
+    add_block(fc.proto, e1 + 1, R(3), R(0))
+    slot = e1 + 1
+    # Consistent: head R2, target (epoch 1, R2's chain checkpoint = R2)
+    fc.on_attestation(_attestation(T, slot, R(2), 1, R(2)))
+    # Inconsistent: head R3 (checkpoint at epoch 1 start is R0), target R2
+    with pytest.raises(InvalidAttestation):
+        fc.on_attestation(_attestation(T, slot, R(3), 1, R(2)))
+    # Consistent fork vote: head R3, target R0
+    fc.on_attestation(_attestation(T, slot, R(3), 1, R(0), indices=(1,)))
+
+
+def test_on_tick_promotes_unrealized_checkpoints():
+    """Crossing an epoch boundary must promote unrealized j/f checkpoints
+    even without new block imports (spec on_tick_per_slot; ADVICE r1)."""
+    fc = make_wrapper(current_slot=3)
+    fc.store.unrealized_justified_checkpoint = FcCheckpoint(epoch=1, root=R(1))
+    fc.store.unrealized_finalized_checkpoint = FcCheckpoint(epoch=0, root=R(0))
+    add_block(fc.proto, 1, R(1), R(0))
+    fc.on_tick(MinimalEthSpec.SLOTS_PER_EPOCH)  # cross into epoch 1
+    assert fc.store.justified_checkpoint.epoch == 1
+    assert fc.store.justified_checkpoint.root == R(1)

@@ -1,0 +1,171 @@
+"""RFC 9380 conformance for hash-to-G2 (BLS12381G2_XMD:SHA-256_SSWU_RO_).
+
+Pins (1) the expand_message_xmd SHA-256 expander against RFC 9380 §K.1
+vectors, (2) the full hash_to_curve pipeline against the §J.10.1
+known-answer vectors, and (3) the 3-isogeny rational map against the
+Appendix E.3 coefficient table via exact polynomial expansion of the
+Vélu-derived map (the two must agree coefficient-for-coefficient).
+
+Reference parity: the reference hashes to G2 inside blst with the same
+ciphersuite (crypto/bls/src/impls/blst.rs:13 DST); matching the RFC vectors
+is what makes signatures wire-compatible with it.
+"""
+
+import lighthouse_tpu.crypto.bls12_381.fields as F
+import lighthouse_tpu.crypto.bls12_381.hash_to_curve as H
+from lighthouse_tpu.crypto.bls12_381.curve import (
+    FQ2,
+    H2_EFF,
+    g2_in_subgroup,
+    to_affine,
+)
+from lighthouse_tpu.crypto.bls12_381.fields import P
+from lighthouse_tpu.crypto.bls12_381.hash_to_curve import (
+    expand_message_xmd,
+    hash_to_g2,
+    map_to_curve_sswu,
+)
+
+# --- §K.1: expand_message_xmd with SHA-256 ---------------------------------
+
+XMD_DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+XMD_VECTORS = [
+    (b"", 0x20, "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"),
+    (b"abc", 0x20, "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"),
+    (
+        b"abcdef0123456789",
+        0x20,
+        "eff31487c770a893cfb36f912fbfcbff40d5661771ca4b2cb4eafe524333f5c1",
+    ),
+]
+
+
+def test_expand_message_xmd_rfc_vectors():
+    for msg, n, expect in XMD_VECTORS:
+        assert expand_message_xmd(msg, XMD_DST, n).hex() == expect
+
+
+# --- §J.10.1: BLS12381G2_XMD:SHA-256_SSWU_RO_ ------------------------------
+
+G2_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+G2_VECTORS = [
+    (
+        b"",
+        (
+            0x0141EBFBDCA40EB85B87142E130AB689C673CF60F1A3E98D69335266F30D9B8D4AC44C1038E9DCDD5393FAF5C41FB78A,
+            0x05CB8437535E20ECFFAEF7752BADDF98034139C38452458BAEEFAB379BA13DFF5BF5DD71B72418717047F5B0F37DA03D,
+            0x0503921D7F6A12805E72940B963C0CF3471C7B2A524950CA195D11062EE75EC076DAF2D4BC358C4B190C0C98064FDD92,
+            0x12424AC32561493F3FE3C260708A12B7C620E7BE00099A974E259DDC7D1F6395C3C811CDD19F1E8DBF3E9ECFDCBAB8D6,
+        ),
+    ),
+    (
+        b"abc",
+        (
+            0x02C2D18E033B960562AAE3CAB37A27CE00D80CCD5BA4B7FE0E7A210245129DBEC7780CCC7954725F4168AFF2787776E6,
+            0x139CDDBCCDC5E91B9623EFD38C49F81A6F83F175E80B06FC374DE9EB4B41DFE4CA3A230ED250FBE3A2ACF73A41177FD8,
+            0x1787327B68159716A37440985269CF584BCB1E621D3A7202BE6EA05C4CFE244AEB197642555A0645FB87BF7466B2BA48,
+            0x00AA65DAE3C8D732D10ECD2C50F8A1BAF3001578F71C694E03866E9F3D49AC1E1CE70DD94A733534F106D4CEC0EDDD16,
+        ),
+    ),
+]
+
+
+def test_hash_to_g2_rfc_vectors():
+    for msg, (xc0, xc1, yc0, yc1) in G2_VECTORS:
+        pt = hash_to_g2(msg, G2_DST)
+        (gx0, gx1), (gy0, gy1) = to_affine(FQ2, pt)
+        assert (gx0, gx1, gy0, gy1) == (xc0, xc1, yc0, yc1), msg
+        assert g2_in_subgroup(pt)
+
+
+def test_h2_eff_matches_rfc_constant():
+    # RFC 9380 §8.8.2 h_eff literal
+    assert H2_EFF == int(
+        "0xbc69f08f2ee75b3584c6a0ea91b352888e2a8e9145ad7689986ff03150"
+        "8ffe1329c2f178731db956d82bf015d1212b02ec0ec69d7477c1ae954cbc"
+        "06689f6a359894c0adebbf6b4e8020005aaa95551",
+        16,
+    )
+
+
+# --- Appendix E.3 isogeny table vs the Vélu-derived map --------------------
+
+
+def _pmul(a, b):
+    out = [(0, 0)] * (len(a) + len(b) - 1)
+    for i, ai in enumerate(a):
+        for j, bj in enumerate(b):
+            out[i + j] = F.f2_add(out[i + j], F.f2_mul(ai, bj))
+    return out
+
+
+def _padd(a, b):
+    n = max(len(a), len(b))
+    za, zb = a + [(0, 0)] * (n - len(a)), b + [(0, 0)] * (n - len(b))
+    return [F.f2_add(x, y) for x, y in zip(za, zb)]
+
+
+def _pscale(a, s):
+    return [F.f2_mul(c, s) for c in a]
+
+
+def test_isogeny_matches_rfc_e3_table():
+    """Expand x_num=(x·d²+t·d+u)/9, y_num=-(d³-t·d-2u)/27 over d=x-x0 and
+    compare against the RFC 9380 E.3 k_(i,j) coefficient table."""
+    x0, t, u = H._X0, H._T, H._U
+    inv9 = (pow(9, -1, P), 0)
+    inv27 = (pow(27, -1, P), 0)
+    d = [F.f2_neg(x0), F.F2_ONE]
+    d2, d3 = _pmul(d, d), _pmul(_pmul(d, d), d)
+    xp = [(0, 0), (1, 0)]
+    x_num = _pscale(_padd(_padd(_pmul(xp, d2), _pscale(d, t)), [u]), inv9)
+    y_num = _pscale(
+        _padd(_padd(d3, _pscale(d, F.f2_neg(t))), [F.f2_mul_scalar(u, P - 2)]),
+        F.f2_neg(inv27),
+    )
+
+    K1_01 = 0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6
+    k1 = [
+        (K1_01, K1_01),
+        (0, 0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A),
+        (
+            0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+            0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D,
+        ),
+        (0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1, 0),
+    ]
+    k2 = [(0, P - 72), (12, P - 12), (1, 0)]
+    K3_00 = 0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706
+    k3 = [
+        (K3_00, K3_00),
+        (0, 0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE),
+        (
+            0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+            0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F,
+        ),
+        (0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10, 0),
+    ]
+    k4 = [(P - 432, P - 432), (0, P - 216), (18, P - 18), (1, 0)]
+
+    for got, want in [(x_num, k1), (d2, k2), (y_num, k3), (d3, k4)]:
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            assert F.f2_sub(g, w) == (0, 0)
+
+
+def test_sswu_isogeny_composition_lands_on_e2():
+    """SSWU output sits on E'; the isogeny must land on E2: y² = x³ + 4(1+u)."""
+    for i in range(4):
+        fe = H.hash_to_field_fq2(bytes([i]), 1, G2_DST)[0]
+        x, y = map_to_curve_sswu(fe)
+        # on E'?
+        lhs = F.f2_sqr(y)
+        rhs = F.f2_add(
+            F.f2_add(F.f2_mul(F.f2_sqr(x), x), F.f2_mul(H._A, x)), H._B
+        )
+        assert F.f2_sub(lhs, rhs) == (0, 0)
+        # isogeny lands on E2?
+        ix, iy = H._isogeny_to_e2(x, y)
+        lhs = F.f2_sqr(iy)
+        rhs = F.f2_add(F.f2_mul(F.f2_sqr(ix), ix), (4, 4))
+        assert F.f2_sub(lhs, rhs) == (0, 0)
