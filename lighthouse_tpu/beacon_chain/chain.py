@@ -437,21 +437,32 @@ class BeaconChain:
     # ------------------------------------------------------------------ production
 
     def produce_block_on_state(
-        self, slot: int, randao_reveal: bytes, graffiti: bytes = b"\x00" * 32
+        self,
+        slot: int,
+        randao_reveal: bytes,
+        graffiti: bytes = b"\x00" * 32,
+        sync_aggregate_fn=None,
     ):
         """Unsigned block on the current head (beacon_chain.rs:4137,4720):
         advances head state, packs the op pool, computes the state root.
-        Returns (block, post_state)."""
+        Fork-aware: builds the block variant the advanced state requires
+        (sync aggregate from `sync_aggregate_fn(state)` or empty, payload
+        with the expected withdrawals sweep). Returns (block, post_state)."""
+        from ..state_processing.bellatrix import is_merge_transition_complete
+        from ..types.chain_spec import ForkName
+
         state = self.head_state.copy()
         parent_root = self.head_root
         while state.slot < slot:
             per_slot_processing(state, self.spec, self.E)
+        fork = self.types.fork_of_state(state)
+        tf = self.types.types_for_fork(fork)
         proposer = get_beacon_proposer_index(state, self.E)
         attestations = self.op_pool.get_attestations_for_block(state)
         proposer_slashings, attester_slashings, exits = (
             self.op_pool.get_slashings_and_exits(state)
         )
-        body = self.types.BeaconBlockBody(
+        body_kwargs = dict(
             randao_reveal=randao_reveal,
             eth1_data=state.eth1_data,
             graffiti=graffiti,
@@ -460,19 +471,41 @@ class BeaconChain:
             attestations=attestations,
             voluntary_exits=exits,
         )
-        block = self.types.BeaconBlock(
+        if fork >= ForkName.ALTAIR:
+            if sync_aggregate_fn is not None:
+                body_kwargs["sync_aggregate"] = sync_aggregate_fn(state)
+            else:
+                body_kwargs["sync_aggregate"] = empty_sync_aggregate(
+                    self.types, self.E
+                )
+        if fork >= ForkName.BELLATRIX:
+            payload_cls = tf.ExecutionPayload
+            payload_kwargs = {}
+            if fork >= ForkName.CAPELLA:
+                from ..state_processing.capella import get_expected_withdrawals
+
+                payload_kwargs["withdrawals"] = get_expected_withdrawals(
+                    state, self.E
+                )
+            if is_merge_transition_complete(state):
+                raise BlockError(
+                    "post-merge payload production requires an execution "
+                    "layer (get_payload) — wire chain.execution_layer"
+                )
+            body_kwargs["execution_payload"] = payload_cls(**payload_kwargs)
+        block = tf.BeaconBlock(
             slot=slot,
             proposer_index=proposer,
             parent_root=parent_root,
             state_root=b"\x00" * 32,
-            body=body,
+            body=tf.BeaconBlockBody(**body_kwargs),
         )
         post = state.copy()
         ctxt = ConsensusContext(slot)
         ctxt.set_proposer_index(proposer)
         per_block_processing(
             post,
-            self.types.SignedBeaconBlock(message=block),
+            tf.SignedBeaconBlock(message=block),
             self.spec,
             self.E,
             strategy=BlockSignatureStrategy.NO_VERIFICATION,
@@ -481,6 +514,17 @@ class BeaconChain:
         )
         block.state_root = post.hash_tree_root()
         return block, post
+
+
+def empty_sync_aggregate(types, E):
+    """No-participation sync aggregate: all-zero bits + the G2 infinity
+    signature (required by eth_fast_aggregate_verify's empty rule)."""
+    from ..crypto import bls
+
+    return types.SyncAggregate(
+        sync_committee_bits=[False] * E.SYNC_COMMITTEE_SIZE,
+        sync_committee_signature=bls.INFINITY_SIGNATURE,
+    )
 
 
 def _genesis_block_root(genesis_state, types) -> bytes:

@@ -57,8 +57,12 @@ class BeaconChainHarness:
     def _sign(self, validator_index: int, root: bytes) -> bytes:
         return self.keypairs[validator_index].sk.sign(root).to_bytes()
 
-    def sign_block(self, block):
-        state = self.chain.head_state
+    def sign_block(self, block, state=None):
+        """`state` must share the block's fork (pass the advanced proposer
+        state at fork-boundary slots — the domain draws on state.fork)."""
+        state = state if state is not None else self.chain.head_state
+        t = self.chain.types
+        fork = t.fork_of_block(block)
         domain = get_domain(
             state,
             Domain.BEACON_PROPOSER,
@@ -67,18 +71,55 @@ class BeaconChainHarness:
             self.E,
         )
         root = compute_signing_root(block.hash_tree_root(), domain)
-        return self.chain.types.SignedBeaconBlock(
+        return t.types_for_fork(fork).SignedBeaconBlock(
             message=block, signature=self._sign(block.proposer_index, root)
         )
 
-    def randao_reveal(self, proposer_index: int, slot: int) -> bytes:
-        state = self.chain.head_state
+    def randao_reveal(self, proposer_index: int, slot: int, state=None) -> bytes:
+        state = state if state is not None else self.chain.head_state
         epoch = compute_epoch_at_slot(slot, self.E)
         domain = get_domain(state, Domain.RANDAO, epoch, self.spec, self.E)
         root = compute_signing_root(
             epoch.to_bytes(8, "little").ljust(32, b"\x00"), domain
         )
         return self._sign(proposer_index, root)
+
+    def make_sync_aggregate(self, state, slot: int, parent_root: bytes):
+        """Full-participation sync aggregate: every committee member we hold
+        keys for signs the previous slot's block root
+        (altair/validator.md sync committee duties)."""
+        from ..crypto import bls
+        from .chain import empty_sync_aggregate
+
+        t = self.chain.types
+        committee = list(state.current_sync_committee.pubkeys)
+        by_pubkey = {
+            kp.pk.to_bytes(): i for i, kp in enumerate(self.keypairs)
+        }
+        previous_slot = max(slot, 1) - 1
+        domain = get_domain(
+            state,
+            Domain.SYNC_COMMITTEE,
+            compute_epoch_at_slot(previous_slot, self.E),
+            self.spec,
+            self.E,
+        )
+        message = compute_signing_root(parent_root, domain)
+        bits, sigs = [], []
+        for pk in committee:
+            vi = by_pubkey.get(bytes(pk))
+            if vi is None:
+                bits.append(False)
+                continue
+            bits.append(True)
+            sigs.append(self.keypairs[vi].sk.sign(message))
+        if not sigs:
+            return empty_sync_aggregate(t, self.E)
+        aggregate = bls.AggregateSignature.from_signatures(sigs).to_signature()
+        return t.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=aggregate.to_bytes(),
+        )
 
     # -- attestations -------------------------------------------------------
 
@@ -180,10 +221,15 @@ class BeaconChainHarness:
         from ..state_processing.accessors import get_beacon_proposer_index
 
         proposer = get_beacon_proposer_index(proposer_state, self.E)
+        parent_root = self.chain.head_root
         block, _post = self.chain.produce_block_on_state(
-            slot, self.randao_reveal(proposer, slot)
+            slot,
+            self.randao_reveal(proposer, slot, proposer_state),
+            sync_aggregate_fn=lambda st: self.make_sync_aggregate(
+                st, slot, parent_root
+            ),
         )
-        signed = self.sign_block(block)
+        signed = self.sign_block(block, proposer_state)
         root = self.chain.process_block(signed)
         return root, signed
 

@@ -311,6 +311,38 @@ class ByteList(SSZType):
         return (cls.LIMIT + 31) // 32
 
 
+class ParticipationList(ByteList):
+    """`List[ParticipationFlags]` (uint8) with a MUTABLE bytearray runtime
+    representation: altair participation flags are updated per attesting
+    index in place (process_attestation), and the epoch sweep reads them
+    zero-copy via numpy frombuffer. Wire format identical to List[uint8]."""
+
+    def _make(cls, limit):
+        return type(
+            f"ParticipationList{limit}", (ParticipationList,), {"LIMIT": limit}
+        )
+
+    __class_getitem__ = _cached(_make)
+    del _make
+
+    @classmethod
+    def deserialize(cls, data: bytes):
+        if len(data) > cls.LIMIT:
+            raise DeserializationError(f"ParticipationList: got {len(data)}")
+        return bytearray(data)
+
+    @classmethod
+    def default(cls):
+        return bytearray()
+
+    @classmethod
+    def coerce(cls, value):
+        b = bytearray(value)
+        if len(b) > cls.LIMIT:
+            raise ValueError(f"ParticipationList: got {len(b)} bytes")
+        return b
+
+
 # ---------------------------------------------------------------------------
 # Vector / List
 # ---------------------------------------------------------------------------
@@ -837,6 +869,8 @@ class Container(SSZType, metaclass=_ContainerMeta):
 def _deep_copy(ftype, value):
     if isinstance(value, Container):
         return value.copy()
+    if isinstance(value, bytearray):
+        return bytearray(value)
     if isinstance(value, list):
         elem_t = getattr(ftype, "ELEM", None)
         if elem_t is not None and not _is_basic(elem_t) and not issubclass(

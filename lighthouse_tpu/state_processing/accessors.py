@@ -353,6 +353,10 @@ def initiate_validator_exit(state, index: int, spec: ChainSpec, E):
 def slash_validator(
     state, slashed_index: int, spec: ChainSpec, E, whistleblower_index=None
 ):
+    from ..types.chain_spec import ForkName
+    from ..types.containers import build_types
+
+    fork = build_types(E).fork_of_state(state)
     epoch = get_current_epoch(state, E)
     initiate_validator_exit(state, slashed_index, spec, E)
     v = state.validators[slashed_index]
@@ -361,14 +365,23 @@ def slash_validator(
         v.withdrawable_epoch, epoch + E.EPOCHS_PER_SLASHINGS_VECTOR
     )
     state.slashings[epoch % E.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
-    decrease_balance(
-        state, slashed_index, v.effective_balance // E.MIN_SLASHING_PENALTY_QUOTIENT
-    )
+    if fork >= ForkName.BELLATRIX:
+        quotient = E.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+    elif fork >= ForkName.ALTAIR:
+        quotient = E.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+    else:
+        quotient = E.MIN_SLASHING_PENALTY_QUOTIENT
+    decrease_balance(state, slashed_index, v.effective_balance // quotient)
     proposer_index = get_beacon_proposer_index(state, E)
     if whistleblower_index is None:
         whistleblower_index = proposer_index
     whistleblower_reward = v.effective_balance // E.WHISTLEBLOWER_REWARD_QUOTIENT
-    proposer_reward = whistleblower_reward // E.PROPOSER_REWARD_QUOTIENT
+    if fork >= ForkName.ALTAIR:
+        from .altair import PROPOSER_WEIGHT, WEIGHT_DENOMINATOR
+
+        proposer_reward = whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
+    else:
+        proposer_reward = whistleblower_reward // E.PROPOSER_REWARD_QUOTIENT
     increase_balance(state, proposer_index, proposer_reward)
     increase_balance(
         state, whistleblower_index, whistleblower_reward - proposer_reward

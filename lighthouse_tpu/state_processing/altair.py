@@ -1,0 +1,630 @@
+"""Altair+ state transition: participation flags, sync committees, and the
+fused, vectorized epoch sweep.
+
+Reference parity: consensus/state_processing/src/per_epoch_processing/
+altair.rs:55 dispatching into single_pass.rs:20 (the fused all-validator
+epoch loop), per_block_processing/altair/sync_committee.rs (sync-aggregate
+processing), and the altair/bellatrix/deneb consensus specs.
+
+TPU-first design: the reference fuses its epoch loops into one sequential
+pass per validator (single_pass.rs). Here the same sweeps are expressed as
+whole-registry numpy u64/u8 array arithmetic — flags, balances, effective
+balances and inactivity scores live in flat arrays, every per-validator
+branch becomes a mask, and the arithmetic is exactly-u64 (checked: every
+intermediate product stays below 2**64; see _REWARD_RANGE_DOC). This is the
+memory layout the device epoch kernel consumes directly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..types.chain_spec import ChainSpec, ForkName
+from .accessors import (
+    compute_epoch_at_slot,
+    decrease_balance,
+    get_active_validator_indices,
+    get_beacon_committee,
+    get_block_root,
+    get_block_root_at_slot,
+    get_current_epoch,
+    get_domain,
+    get_previous_epoch,
+    get_seed,
+    get_total_active_balance,
+    increase_balance,
+    int_sqrt,
+    invalidate_caches,
+)
+from .per_epoch import weigh_justification_and_finalization
+from .shuffle import compute_shuffled_index
+
+# --- Participation flags (altair/beacon-chain.md) ---------------------------
+
+TIMELY_SOURCE_FLAG_INDEX = 0
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+
+TIMELY_SOURCE_WEIGHT = 14
+TIMELY_TARGET_WEIGHT = 26
+TIMELY_HEAD_WEIGHT = 14
+SYNC_REWARD_WEIGHT = 2
+PROPOSER_WEIGHT = 8
+WEIGHT_DENOMINATOR = 64
+
+PARTICIPATION_FLAG_WEIGHTS = [
+    TIMELY_SOURCE_WEIGHT,
+    TIMELY_TARGET_WEIGHT,
+    TIMELY_HEAD_WEIGHT,
+]
+
+# u64-exactness argument for the vectorized reward math:
+#   effective_balance <= 2**35 (32 ETH in gwei), base_reward < 2**27 even on
+#   tiny nets, weight <= 64 = 2**6, participating increments < 2**26 at 10M
+#   validators => base_reward * weight * increments < 2**59. The inactivity
+#   penalty computes eb * inactivity_score: safe while score < 2**28 (scores
+#   grow 4/epoch during leaks; 2**28 would need ~2M years of leaking) —
+#   asserted below rather than assumed.
+_REWARD_RANGE_DOC = True
+
+
+def has_flag(flags: int, flag_index: int) -> bool:
+    return bool(flags & (1 << flag_index))
+
+
+def add_flag(flags: int, flag_index: int) -> int:
+    return flags | (1 << flag_index)
+
+
+# --- Base rewards -----------------------------------------------------------
+
+
+def get_base_reward_per_increment(state, E) -> int:
+    return (
+        E.EFFECTIVE_BALANCE_INCREMENT
+        * E.BASE_REWARD_FACTOR
+        // int_sqrt(get_total_active_balance(state, E))
+    )
+
+
+def get_base_reward_altair(state, index: int, E) -> int:
+    increments = (
+        state.validators[index].effective_balance // E.EFFECTIVE_BALANCE_INCREMENT
+    )
+    return increments * get_base_reward_per_increment(state, E)
+
+
+# --- Attestation participation (altair process_attestation) ----------------
+
+
+def get_attestation_participation_flag_indices(
+    state, data, inclusion_delay: int, E, fork: ForkName
+) -> list[int]:
+    from .per_block import BlockProcessingError
+
+    if data.target.epoch == get_current_epoch(state, E):
+        justified_checkpoint = state.current_justified_checkpoint
+    else:
+        justified_checkpoint = state.previous_justified_checkpoint
+
+    is_matching_source = data.source == justified_checkpoint
+    if not is_matching_source:
+        raise BlockProcessingError("attestation: source checkpoint mismatch")
+    is_matching_target = is_matching_source and data.target.root == get_block_root(
+        state, data.target.epoch, E
+    )
+    is_matching_head = (
+        is_matching_target
+        and data.beacon_block_root == get_block_root_at_slot(state, data.slot, E)
+    )
+
+    flags = []
+    if is_matching_source and inclusion_delay <= int_sqrt(E.SLOTS_PER_EPOCH):
+        flags.append(TIMELY_SOURCE_FLAG_INDEX)
+    if fork >= ForkName.DENEB:
+        # EIP-7045: no inclusion-delay bound on the target flag.
+        if is_matching_target:
+            flags.append(TIMELY_TARGET_FLAG_INDEX)
+    elif is_matching_target and inclusion_delay <= E.SLOTS_PER_EPOCH:
+        flags.append(TIMELY_TARGET_FLAG_INDEX)
+    if is_matching_head and inclusion_delay == E.MIN_ATTESTATION_INCLUSION_DELAY:
+        flags.append(TIMELY_HEAD_FLAG_INDEX)
+    return flags
+
+
+def process_attestation_altair(
+    state, attestation, spec: ChainSpec, E, verify_signatures: bool, ctxt, fork
+):
+    from .accessors import committee_cache_at
+    from .per_block import BlockProcessingError, is_valid_indexed_attestation
+
+    data = attestation.data
+    current = get_current_epoch(state, E)
+    previous = get_previous_epoch(state, E)
+    if data.target.epoch not in (previous, current):
+        raise BlockProcessingError("attestation: target epoch out of range")
+    if data.target.epoch != compute_epoch_at_slot(data.slot, E):
+        raise BlockProcessingError("attestation: target/slot mismatch")
+    if state.slot < data.slot + E.MIN_ATTESTATION_INCLUSION_DELAY:
+        raise BlockProcessingError("attestation: too early")
+    if fork < ForkName.DENEB and state.slot > data.slot + E.SLOTS_PER_EPOCH:
+        # EIP-7045 (Deneb) removed the one-epoch inclusion upper bound.
+        raise BlockProcessingError("attestation: inclusion window")
+    cc = committee_cache_at(state, data.target.epoch, E)
+    if data.index >= cc.committees_per_slot:
+        raise BlockProcessingError("attestation: committee index out of range")
+    committee = get_beacon_committee(state, data.slot, data.index, E)
+    if len(attestation.aggregation_bits) != len(committee):
+        raise BlockProcessingError("attestation: bitfield length mismatch")
+
+    inclusion_delay = state.slot - data.slot
+    flag_indices = get_attestation_participation_flag_indices(
+        state, data, inclusion_delay, E, fork
+    )
+
+    indexed = ctxt.get_indexed_attestation(state, attestation, E)
+    if not is_valid_indexed_attestation(
+        state, indexed, spec, E, verify_signature=verify_signatures
+    ):
+        raise BlockProcessingError("attestation: invalid indexed attestation")
+
+    participation = (
+        state.current_epoch_participation
+        if data.target.epoch == current
+        else state.previous_epoch_participation
+    )
+    base_reward_per_increment = get_base_reward_per_increment(state, E)
+    proposer_reward_numerator = 0
+    for index in indexed.attesting_indices:
+        eb_increments = (
+            state.validators[index].effective_balance
+            // E.EFFECTIVE_BALANCE_INCREMENT
+        )
+        base_reward = eb_increments * base_reward_per_increment
+        flags = participation[index]
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            if flag_index in flag_indices and not has_flag(flags, flag_index):
+                flags = add_flag(flags, flag_index)
+                proposer_reward_numerator += base_reward * weight
+        participation[index] = flags
+
+    proposer_reward_denominator = (
+        (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+        * WEIGHT_DENOMINATOR
+        // PROPOSER_WEIGHT
+    )
+    increase_balance(
+        state,
+        ctxt.get_proposer_index(state, E),
+        proposer_reward_numerator // proposer_reward_denominator,
+    )
+
+
+# --- Sync committees --------------------------------------------------------
+
+
+def get_next_sync_committee_indices(state, E) -> list[int]:
+    """altair/beacon-chain.md get_next_sync_committee_indices: effective-
+    balance-weighted sampling over the shuffled active set."""
+    from ..types.chain_spec import Domain
+    from ..utils.hash import sha256 as hash_bytes
+
+    epoch = get_current_epoch(state, E) + 1
+    active = get_active_validator_indices(state, epoch)
+    active_count = len(active)
+    seed = get_seed(state, epoch, Domain.SYNC_COMMITTEE, E)
+    indices: list[int] = []
+    i = 0
+    while len(indices) < E.SYNC_COMMITTEE_SIZE:
+        shuffled = compute_shuffled_index(
+            i % active_count, active_count, seed, E.SHUFFLE_ROUND_COUNT
+        )
+        candidate = active[shuffled]
+        random_byte = hash_bytes(seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        effective_balance = state.validators[candidate].effective_balance
+        if effective_balance * 255 >= E.MAX_EFFECTIVE_BALANCE * random_byte:
+            indices.append(candidate)
+        i += 1
+    return indices
+
+
+def get_next_sync_committee(state, E):
+    from ..crypto import bls
+    from ..types.containers import build_types
+
+    t = build_types(E)
+    indices = get_next_sync_committee_indices(state, E)
+    pubkeys = [state.validators[i].pubkey for i in indices]
+    aggregate = bls.aggregate_pubkeys(
+        [bls.PublicKey(pk) for pk in pubkeys]
+    ).to_bytes()
+    return t.SyncCommittee(pubkeys=pubkeys, aggregate_pubkey=aggregate)
+
+
+def sync_aggregate_signature_set(
+    state, sync_aggregate, slot: int, spec: ChainSpec, E
+):
+    """Signature set for a block's sync aggregate: participants sign the
+    previous slot's block root with the SYNC_COMMITTEE domain
+    (signature_sets.rs sync_aggregate_signature_set)."""
+    from ..crypto import bls
+    from ..types.chain_spec import Domain, compute_signing_root
+    from .signature_sets import pubkey_from_bytes
+
+    previous_slot = max(slot, 1) - 1
+    domain = get_domain(
+        state,
+        Domain.SYNC_COMMITTEE,
+        compute_epoch_at_slot(previous_slot, E),
+        spec,
+        E,
+    )
+    root = get_block_root_at_slot(state, previous_slot, E)
+    message = compute_signing_root(root, domain)
+    pubkeys = [
+        pubkey_from_bytes(pk)
+        for pk, bit in zip(
+            state.current_sync_committee.pubkeys,
+            sync_aggregate.sync_committee_bits,
+        )
+        if bit
+    ]
+    return bls.SignatureSet(
+        signature=bls.Signature(sync_aggregate.sync_committee_signature),
+        pubkeys=pubkeys,
+        message=message,
+    )
+
+
+def process_sync_aggregate(
+    state, sync_aggregate, spec: ChainSpec, E, verify_signatures: bool, ctxt
+):
+    from ..crypto import bls
+    from .per_block import BlockProcessingError
+
+    if verify_signatures:
+        participant_pubkeys = [
+            pk
+            for pk, bit in zip(
+                state.current_sync_committee.pubkeys,
+                sync_aggregate.sync_committee_bits,
+            )
+            if bit
+        ]
+        sig = bls.Signature(sync_aggregate.sync_committee_signature)
+        if not participant_pubkeys:
+            # eth_fast_aggregate_verify: empty participants require the
+            # infinity signature (G2 point at infinity).
+            if not sig.is_infinity():
+                raise BlockProcessingError("sync aggregate: bad empty signature")
+        elif not sync_aggregate_signature_set(
+            state, sync_aggregate, state.slot, spec, E
+        ).verify():
+            raise BlockProcessingError("sync aggregate: invalid signature")
+
+    # Rewards (sync_committee.rs / spec process_sync_aggregate)
+    total_active_increments = (
+        get_total_active_balance(state, E) // E.EFFECTIVE_BALANCE_INCREMENT
+    )
+    total_base_rewards = (
+        get_base_reward_per_increment(state, E) * total_active_increments
+    )
+    max_participant_rewards = (
+        total_base_rewards
+        * SYNC_REWARD_WEIGHT
+        // WEIGHT_DENOMINATOR
+        // E.SLOTS_PER_EPOCH
+    )
+    participant_reward = max_participant_rewards // E.SYNC_COMMITTEE_SIZE
+    proposer_reward = (
+        participant_reward * PROPOSER_WEIGHT // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    )
+
+    proposer_index = ctxt.get_proposer_index(state, E)
+    committee_indices = [
+        _validator_index_of(state, pk)
+        for pk in state.current_sync_committee.pubkeys
+    ]
+    for participant_index, bit in zip(
+        committee_indices, sync_aggregate.sync_committee_bits
+    ):
+        if bit:
+            increase_balance(state, participant_index, participant_reward)
+            increase_balance(state, proposer_index, proposer_reward)
+        else:
+            decrease_balance(state, participant_index, participant_reward)
+
+
+def _validator_index_of(state, pubkey: bytes) -> int:
+    from .per_block import _validator_index_by_pubkey
+
+    index = _validator_index_by_pubkey(state, pubkey)
+    if index is None:
+        from .per_block import BlockProcessingError
+
+        raise BlockProcessingError("sync committee pubkey not in registry")
+    return index
+
+
+# --- Vectorized epoch processing -------------------------------------------
+
+
+class EpochArrays:
+    """Flat-array registry snapshot for one epoch transition — the TPU-side
+    layout (single_pass.rs's per-validator struct turned into columns)."""
+
+    def __init__(self, state, E):
+        n = len(state.validators)
+        vs = state.validators
+        self.n = n
+        self.effective_balance = np.fromiter(
+            (v.effective_balance for v in vs), dtype=np.uint64, count=n
+        )
+        self.activation_epoch = np.fromiter(
+            (v.activation_epoch for v in vs), dtype=np.uint64, count=n
+        )
+        self.exit_epoch = np.fromiter(
+            (v.exit_epoch for v in vs), dtype=np.uint64, count=n
+        )
+        self.withdrawable_epoch = np.fromiter(
+            (v.withdrawable_epoch for v in vs), dtype=np.uint64, count=n
+        )
+        self.slashed = np.fromiter(
+            (v.slashed for v in vs), dtype=bool, count=n
+        )
+        self.prev_participation = np.frombuffer(
+            state.previous_epoch_participation, dtype=np.uint8, count=n
+        )
+        self.curr_participation = np.frombuffer(
+            state.current_epoch_participation, dtype=np.uint8, count=n
+        )
+
+    def active_at(self, epoch: int) -> np.ndarray:
+        e = np.uint64(epoch)
+        return (self.activation_epoch <= e) & (e < self.exit_epoch)
+
+    def unslashed_participating(self, flag_index: int, epoch_is_prev: bool):
+        part = self.prev_participation if epoch_is_prev else self.curr_participation
+        flag = np.uint8(1 << flag_index)
+        return (part & flag).astype(bool) & ~self.slashed
+
+
+def get_unslashed_participating_balance(
+    arrays: EpochArrays, flag_index: int, epoch_is_prev: bool, active: np.ndarray, E
+) -> int:
+    mask = arrays.unslashed_participating(flag_index, epoch_is_prev) & active
+    total = int(arrays.effective_balance[mask].sum(dtype=np.uint64))
+    return max(total, E.EFFECTIVE_BALANCE_INCREMENT)
+
+
+def process_justification_and_finalization_altair(
+    state, E, arrays: EpochArrays | None = None
+):
+    """Justification totals from participation flags (vectorized), then the
+    shared FFG weighing (per_epoch.weigh_justification_and_finalization)."""
+    from ..types.chain_spec import GENESIS_EPOCH
+
+    current = get_current_epoch(state, E)
+    if current <= GENESIS_EPOCH + 1:
+        return
+    arrays = arrays or EpochArrays(state, E)
+    prev_active = arrays.active_at(get_previous_epoch(state, E))
+    curr_active = arrays.active_at(current)
+    total_active = max(
+        int(arrays.effective_balance[curr_active].sum(dtype=np.uint64)),
+        E.EFFECTIVE_BALANCE_INCREMENT,
+    )
+    previous_target = get_unslashed_participating_balance(
+        arrays, TIMELY_TARGET_FLAG_INDEX, True, prev_active, E
+    )
+    current_target = get_unslashed_participating_balance(
+        arrays, TIMELY_TARGET_FLAG_INDEX, False, curr_active, E
+    )
+    weigh_justification_and_finalization(
+        state, total_active, previous_target, current_target, E
+    )
+
+
+def process_inactivity_updates(
+    state, spec: ChainSpec, E, arrays: EpochArrays | None = None
+):
+    from ..types.chain_spec import GENESIS_EPOCH
+    from .per_epoch import get_finality_delay
+
+    current = get_current_epoch(state, E)
+    if current == GENESIS_EPOCH:
+        return
+    arrays = arrays or EpochArrays(state, E)
+    previous = get_previous_epoch(state, E)
+    prev_active = arrays.active_at(previous)
+    eligible = prev_active | (
+        arrays.slashed & (np.uint64(previous + 1) < arrays.withdrawable_epoch)
+    )
+    participating = arrays.unslashed_participating(
+        TIMELY_TARGET_FLAG_INDEX, True
+    ) & prev_active
+
+    scores = np.fromiter(state.inactivity_scores, dtype=np.uint64, count=arrays.n)
+    dec = eligible & participating
+    scores[dec] -= np.minimum(np.uint64(1), scores[dec])
+    inc = eligible & ~participating
+    scores[inc] += np.uint64(spec.inactivity_score_bias)
+    if not get_finality_delay(state, E) > E.MIN_EPOCHS_TO_INACTIVITY_PENALTY:
+        recovery = np.uint64(spec.inactivity_score_recovery_rate)
+        scores[eligible] -= np.minimum(recovery, scores[eligible])
+    state.inactivity_scores[:] = scores.tolist()
+
+
+def process_rewards_and_penalties_altair(
+    state, spec: ChainSpec, E, fork: ForkName, arrays: EpochArrays | None = None
+):
+    """Flag deltas + inactivity penalties as fused array ops
+    (single_pass.rs:20 / altair/beacon-chain.md get_flag_index_deltas)."""
+    from ..types.chain_spec import GENESIS_EPOCH
+    from .per_epoch import get_finality_delay
+
+    current = get_current_epoch(state, E)
+    if current == GENESIS_EPOCH:
+        return
+    arrays = arrays or EpochArrays(state, E)
+    previous = get_previous_epoch(state, E)
+    prev_active = arrays.active_at(previous)
+    curr_active = arrays.active_at(current)
+    eligible = prev_active | (
+        arrays.slashed & (np.uint64(previous + 1) < arrays.withdrawable_epoch)
+    )
+
+    total_active = max(
+        int(arrays.effective_balance[curr_active].sum(dtype=np.uint64)),
+        E.EFFECTIVE_BALANCE_INCREMENT,
+    )
+    base_reward_per_increment = (
+        E.EFFECTIVE_BALANCE_INCREMENT * E.BASE_REWARD_FACTOR // int_sqrt(total_active)
+    )
+    eb_increments = arrays.effective_balance // np.uint64(
+        E.EFFECTIVE_BALANCE_INCREMENT
+    )
+    base_rewards = eb_increments * np.uint64(base_reward_per_increment)
+    total_active_increments = total_active // E.EFFECTIVE_BALANCE_INCREMENT
+
+    in_leak = get_finality_delay(state, E) > E.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+    rewards = np.zeros(arrays.n, dtype=np.uint64)
+    penalties = np.zeros(arrays.n, dtype=np.uint64)
+
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        participating = (
+            arrays.unslashed_participating(flag_index, True) & prev_active
+        )
+        upb = max(
+            int(arrays.effective_balance[participating].sum(dtype=np.uint64)),
+            E.EFFECTIVE_BALANCE_INCREMENT,
+        )
+        upb_increments = upb // E.EFFECTIVE_BALANCE_INCREMENT
+        got_flag = eligible & participating
+        if not in_leak:
+            # reward = base * weight * upi // (tai * WD)
+            numer = (
+                base_rewards[got_flag]
+                * np.uint64(weight)
+                * np.uint64(upb_increments)
+            )
+            rewards[got_flag] += numer // np.uint64(
+                total_active_increments * WEIGHT_DENOMINATOR
+            )
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            missed = eligible & ~participating
+            penalties[missed] += (
+                base_rewards[missed] * np.uint64(weight)
+            ) // np.uint64(WEIGHT_DENOMINATOR)
+
+    # Inactivity penalties (get_inactivity_penalty_deltas)
+    scores = np.fromiter(state.inactivity_scores, dtype=np.uint64, count=arrays.n)
+    assert int(scores.max(initial=0)) < 1 << 28, "inactivity score overflow guard"
+    participating_target = (
+        arrays.unslashed_participating(TIMELY_TARGET_FLAG_INDEX, True) & prev_active
+    )
+    quotient = (
+        E.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+        if fork >= ForkName.BELLATRIX
+        else E.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    )
+    inactive = eligible & ~participating_target
+    penalty_numer = arrays.effective_balance[inactive] * scores[inactive]
+    penalties[inactive] += penalty_numer // np.uint64(
+        spec.inactivity_score_bias * quotient
+    )
+
+    balances = np.fromiter(state.balances, dtype=np.uint64, count=arrays.n)
+    balances += rewards
+    balances = np.maximum(balances, penalties) - penalties  # saturating sub
+    state.balances[:] = balances.tolist()
+
+
+def process_slashings_altair(state, E, fork: ForkName, arrays: EpochArrays | None = None):
+    arrays = arrays or EpochArrays(state, E)
+    epoch = get_current_epoch(state, E)
+    total_balance = get_total_active_balance(state, E)
+    multiplier = (
+        E.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+        if fork >= ForkName.BELLATRIX
+        else E.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+    )
+    adjusted = min(sum(state.slashings) * multiplier, total_balance)
+    target_epoch = np.uint64(epoch + E.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+    mask = arrays.slashed & (arrays.withdrawable_epoch == target_epoch)
+    if not mask.any():
+        return
+    increment = E.EFFECTIVE_BALANCE_INCREMENT
+    for index in np.nonzero(mask)[0]:
+        eb = int(arrays.effective_balance[index])
+        penalty_numerator = eb // increment * adjusted
+        penalty = penalty_numerator // total_balance * increment
+        decrease_balance(state, int(index), penalty)
+
+
+def process_participation_flag_updates(state, E):
+    state.previous_epoch_participation = bytearray(state.current_epoch_participation)
+    state.current_epoch_participation = bytearray(len(state.validators))
+
+
+def process_sync_committee_updates(state, E):
+    next_epoch = get_current_epoch(state, E) + 1
+    if next_epoch % E.EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0:
+        state.current_sync_committee = state.next_sync_committee
+        state.next_sync_committee = get_next_sync_committee(state, E)
+
+
+def process_historical_summaries_update(state, E):
+    """Capella+: append a HistoricalSummary instead of a HistoricalBatch root
+    (capella/beacon-chain.md)."""
+    from ..types.containers import build_types
+
+    t = build_types(E)
+    next_epoch = get_current_epoch(state, E) + 1
+    if next_epoch % (E.SLOTS_PER_HISTORICAL_ROOT // E.SLOTS_PER_EPOCH) == 0:
+        from ..ssz.core import Bytes32, Vector
+
+        block_roots_root = Vector[
+            Bytes32, E.SLOTS_PER_HISTORICAL_ROOT
+        ].hash_tree_root_of(list(state.block_roots))
+        state_roots_root = Vector[
+            Bytes32, E.SLOTS_PER_HISTORICAL_ROOT
+        ].hash_tree_root_of(list(state.state_roots))
+        state.historical_summaries.append(
+            t.HistoricalSummary(
+                block_summary_root=block_roots_root,
+                state_summary_root=state_roots_root,
+            )
+        )
+
+
+def process_epoch_altair(state, spec: ChainSpec, E, fork: ForkName):
+    """Altair+ epoch transition (per_epoch_processing/altair.rs:55)."""
+    from .per_epoch import (
+        process_effective_balance_updates,
+        process_eth1_data_reset,
+        process_historical_roots_update,
+        process_randao_mixes_reset,
+        process_registry_updates,
+        process_slashings_reset,
+    )
+
+    arrays = EpochArrays(state, E)
+    process_justification_and_finalization_altair(state, E, arrays)
+    process_inactivity_updates(state, spec, E, arrays)
+    process_rewards_and_penalties_altair(state, spec, E, fork, arrays)
+    process_registry_updates(state, spec, E)
+    # Registry/balances changed: re-snapshot for slashings sweep.
+    arrays = EpochArrays(state, E)
+    process_slashings_altair(state, E, fork, arrays)
+    process_eth1_data_reset(state, E)
+    process_effective_balance_updates(state, E)
+    process_slashings_reset(state, E)
+    process_randao_mixes_reset(state, E)
+    if fork >= ForkName.CAPELLA:
+        process_historical_summaries_update(state, E)
+    else:
+        process_historical_roots_update(state, E)
+    process_participation_flag_updates(state, E)
+    process_sync_committee_updates(state, E)
+    invalidate_caches(state)

@@ -216,6 +216,18 @@ def interop_genesis_state(
         eth1_block_hash, genesis_time, datas, spec, E
     )
     state.genesis_time = genesis_time
+    # Specs that schedule forks at epoch 0 start the chain in that fork
+    # (the reference's fork_from_env genesis, test_utils.rs).
+    from ..types.chain_spec import ForkName
+    from ..types.containers import build_types
+
+    target_fork = spec.fork_name_at_epoch(GENESIS_EPOCH)
+    if target_fork != ForkName.PHASE0:
+        from .upgrades import apply_upgrades
+
+        apply_upgrades(
+            state, build_types(E).fork_of_state(state), target_fork, spec, E
+        )
     return state
 
 

@@ -162,6 +162,37 @@ class BlockSignatureVerifier:
                 sigsets.exit_signature_set(self.state, exit_, self.spec, self.E)
             )
 
+    def include_sync_aggregate(self, block):
+        """Altair+: one set over the participating sync-committee pubkeys.
+        The empty-participation case must carry the infinity signature and
+        contributes no set (blst.rs fast-aggregate rules)."""
+        aggregate = getattr(block.body, "sync_aggregate", None)
+        if aggregate is None:
+            return
+        if not any(aggregate.sync_committee_bits):
+            if not bls.Signature(aggregate.sync_committee_signature).is_infinity():
+                raise BlockProcessingError(
+                    "sync aggregate: empty participation requires infinity sig"
+                )
+            return
+        from .altair import sync_aggregate_signature_set
+
+        self.sets.append(
+            sync_aggregate_signature_set(
+                self.state, aggregate, block.slot, self.spec, self.E
+            )
+        )
+
+    def include_bls_to_execution_changes(self, block):
+        for change in getattr(block.body, "bls_to_execution_changes", []) or []:
+            from .capella import bls_to_execution_change_signature_set
+
+            self.sets.append(
+                bls_to_execution_change_signature_set(
+                    self.state, change, self.spec, self.E
+                )
+            )
+
     def include_all_signatures(self, signed_block, block_root, ctxt):
         self.include_block_proposal(signed_block, block_root)
         self.include_all_signatures_except_proposal(signed_block.message, ctxt)
@@ -172,6 +203,8 @@ class BlockSignatureVerifier:
         self.include_attester_slashings(block)
         self.include_attestations(block, ctxt)
         self.include_exits(block)
+        self.include_sync_aggregate(block)
+        self.include_bls_to_execution_changes(block)
 
     def verify(self) -> bool:
         if not self.sets:
@@ -194,6 +227,7 @@ def per_block_processing(
     block_root: bytes | None = None,
     verify_block_root: bool = True,
     proposal_already_verified: bool = False,
+    execution_engine=None,
 ):
     """Apply `signed_block` to `state` in place. Raises BlockProcessingError
     on ANY invalid condition (per_block_processing.rs:100) — malformed
@@ -205,7 +239,7 @@ def per_block_processing(
     try:
         _per_block_processing_inner(
             state, signed_block, spec, E, strategy, ctxt, block_root,
-            verify_block_root, proposal_already_verified,
+            verify_block_root, proposal_already_verified, execution_engine,
         )
     except BlockProcessingError:
         raise
@@ -215,7 +249,7 @@ def per_block_processing(
 
 def _per_block_processing_inner(
     state, signed_block, spec, E, strategy, ctxt, block_root,
-    verify_block_root, proposal_already_verified,
+    verify_block_root, proposal_already_verified, execution_engine=None,
 ):
     block = signed_block.message
     if ctxt is None:
@@ -246,7 +280,25 @@ def _per_block_processing_inner(
     elif strategy == BlockSignatureStrategy.VERIFY_RANDAO:
         pass  # randao handled in process_randao below
 
+    from ..types.chain_spec import ForkName
+    from ..types.containers import build_types
+
+    fork = build_types(E).fork_of_state(state)
+
     process_block_header(state, block, ctxt, E)
+    if fork >= ForkName.BELLATRIX:
+        from .bellatrix import is_execution_enabled, process_execution_payload
+
+        if is_execution_enabled(state, block.body):
+            # Capella+: withdrawals are processed only when execution is
+            # enabled (capella/beacon-chain.md process_block).
+            if fork >= ForkName.CAPELLA:
+                from .capella import process_withdrawals
+
+                process_withdrawals(state, block.body.execution_payload, E)
+            process_execution_payload(
+                state, block.body, spec, E, fork, engine=execution_engine
+            )
     process_randao(
         state,
         block,
@@ -256,7 +308,15 @@ def _per_block_processing_inner(
         or strategy == BlockSignatureStrategy.VERIFY_RANDAO,
     )
     process_eth1_data(state, block.body.eth1_data, E)
-    process_operations(state, block.body, spec, E, verify_signatures, ctxt)
+    process_operations(
+        state, block.body, spec, E, verify_signatures, ctxt, fork
+    )
+    if fork >= ForkName.ALTAIR:
+        from .altair import process_sync_aggregate
+
+        process_sync_aggregate(
+            state, block.body.sync_aggregate, spec, E, verify_signatures, ctxt
+        )
 
     if verify_block_root:
         expected = state.hash_tree_root()
@@ -320,8 +380,20 @@ def process_eth1_data(state, eth1_data, E):
 
 
 def process_operations(
-    state, body, spec: ChainSpec, E, verify_signatures: bool, ctxt: ConsensusContext
+    state,
+    body,
+    spec: ChainSpec,
+    E,
+    verify_signatures: bool,
+    ctxt: ConsensusContext,
+    fork=None,
 ):
+    from ..types.chain_spec import ForkName
+
+    if fork is None:
+        from ..types.containers import build_types
+
+        fork = build_types(E).fork_of_state(state)
     # Deposit count check
     expected_deposits = min(
         E.MAX_DEPOSITS,
@@ -336,12 +408,27 @@ def process_operations(
         process_proposer_slashing(state, ps, spec, E, verify_signatures)
     for asl in body.attester_slashings:
         process_attester_slashing(state, asl, spec, E, verify_signatures)
-    for att in body.attestations:
-        process_attestation(state, att, spec, E, verify_signatures, ctxt)
+    if fork >= ForkName.ALTAIR:
+        from .altair import process_attestation_altair
+
+        for att in body.attestations:
+            process_attestation_altair(
+                state, att, spec, E, verify_signatures, ctxt, fork
+            )
+    else:
+        for att in body.attestations:
+            process_attestation(state, att, spec, E, verify_signatures, ctxt)
     for dep in body.deposits:
         process_deposit(state, dep, spec, E)
     for exit_ in body.voluntary_exits:
         process_voluntary_exit(state, exit_, spec, E, verify_signatures)
+    if fork >= ForkName.CAPELLA:
+        from .capella import process_bls_to_execution_change
+
+        for change in body.bls_to_execution_changes:
+            process_bls_to_execution_change(
+                state, change, spec, E, verify_signatures
+            )
 
 
 def process_proposer_slashing(state, ps, spec, E, verify_signatures: bool):
@@ -521,6 +608,11 @@ def add_validator_to_registry(state, data, E):
         )
     )
     state.balances.append(amount)
+    # Altair+ registries carry parallel per-validator lists.
+    if hasattr(state, "previous_epoch_participation"):
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
     cache = getattr(state, "_lh_pubkey_index", None)
     if cache is not None:
         cache[data.pubkey] = len(state.validators) - 1

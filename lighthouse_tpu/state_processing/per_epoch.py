@@ -35,6 +35,21 @@ BASE_REWARDS_PER_EPOCH = 4
 
 
 def process_epoch(state, spec: ChainSpec, E):
+    """Epoch transition, fork-dispatched (per_epoch_processing.rs:44-52):
+    phase0 multi-pass below; Altair+ the fused vectorized pass."""
+    from ..types.chain_spec import ForkName
+    from ..types.containers import build_types
+
+    fork = build_types(E).fork_of_state(state)
+    if fork >= ForkName.ALTAIR:
+        from .altair import process_epoch_altair
+
+        process_epoch_altair(state, spec, E, fork)
+        return
+    process_epoch_phase0(state, spec, E)
+
+
+def process_epoch_phase0(state, spec: ChainSpec, E):
     """Phase0 epoch transition (runs at the last slot of each epoch)."""
     process_justification_and_finalization(state, E)
     process_rewards_and_penalties(state, spec, E)
@@ -109,6 +124,13 @@ def get_attesting_balance(state, attestations, E) -> int:
 
 
 def process_justification_and_finalization(state, E):
+    """Fork-dispatched: phase0 counts pending attestations; Altair+ counts
+    participation flags (callers include fork choice's pull-up computation)."""
+    if not hasattr(state, "previous_epoch_attestations"):
+        from .altair import process_justification_and_finalization_altair
+
+        process_justification_and_finalization_altair(state, E)
+        return
     if get_current_epoch(state, E) <= GENESIS_EPOCH + 1:
         return
     previous_indices = get_unslashed_attesting_indices(
@@ -319,7 +341,13 @@ def process_registry_updates(state, spec: ChainSpec, E):
         ),
         key=lambda i: (state.validators[i].activation_eligibility_epoch, i),
     )
-    for index in activation_queue[: get_validator_churn_limit(state, spec, E)]:
+    # Deneb (EIP-7514) caps the activation churn; exit churn is uncapped.
+    from ..types.containers import build_types
+
+    fork = build_types(E).fork_of_state(state)
+    active_count = len(get_active_validator_indices(state, current))
+    limit = spec.activation_churn_limit(active_count, fork)
+    for index in activation_queue[:limit]:
         state.validators[index].activation_epoch = compute_activation_exit_epoch(
             current, E
         )

@@ -34,21 +34,20 @@ def per_slot_processing(state, spec: ChainSpec, E, state_root: bytes | None = No
 
 
 def _maybe_upgrade_fork(state, spec: ChainSpec, E):
-    """Fork upgrade hook at epoch starts (state_processing/src/upgrade/*.rs).
-    Phase0-only for now; later forks raise until their upgrade lands."""
+    """Fork upgrade hook at epoch starts (state_processing/src/upgrade/*.rs):
+    swaps the state to the scheduled fork's variant in place."""
     if state.slot % E.SLOTS_PER_EPOCH != 0:
         return
     epoch = state.slot // E.SLOTS_PER_EPOCH
-    from ..types.chain_spec import ForkName
     from ..types.containers import build_types
 
     t = build_types(E)
     target_fork = spec.fork_name_at_epoch(epoch)
     current_fork = t.fork_of_state(state)
     if target_fork != current_fork:
-        raise NotImplementedError(
-            f"fork upgrade {current_fork} -> {target_fork} not implemented yet"
-        )
+        from .upgrades import apply_upgrades
+
+        apply_upgrades(state, current_fork, target_fork, spec, E)
 
 
 def state_root_and_advance(state, spec: ChainSpec, E) -> bytes:

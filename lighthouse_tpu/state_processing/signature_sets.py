@@ -89,8 +89,20 @@ def indexed_attestation_signature_set(
 
 
 def exit_signature_set(state, signed_exit, spec: ChainSpec, E) -> bls.SignatureSet:
+    from ..types.chain_spec import ForkName
+    from ..types.containers import build_types
+
     exit_msg = signed_exit.message
-    domain = get_domain(state, Domain.VOLUNTARY_EXIT, exit_msg.epoch, spec, E)
+    fork = build_types(E).fork_of_state(state)
+    if fork >= ForkName.DENEB:
+        # EIP-7044: exits are signed over the Capella fork domain forever.
+        domain = spec.compute_domain_from_parts(
+            Domain.VOLUNTARY_EXIT,
+            spec.capella_fork_version,
+            state.genesis_validators_root,
+        )
+    else:
+        domain = get_domain(state, Domain.VOLUNTARY_EXIT, exit_msg.epoch, spec, E)
     message = compute_signing_root(exit_msg.hash_tree_root(), domain)
     return bls.SignatureSet.single(
         bls.Signature(signed_exit.signature),

@@ -18,6 +18,7 @@ from ..ssz.core import (
     Bitlist,
     Bitvector,
     ByteList,
+    ParticipationList,
     ByteVector,
     Bytes4,
     Bytes20,
@@ -268,8 +269,8 @@ def build_types(E: type) -> SimpleNamespace:
         balances: List[uint64, E.VALIDATOR_REGISTRY_LIMIT]
         randao_mixes: Vector[Bytes32, E.EPOCHS_PER_HISTORICAL_VECTOR]
         slashings: Vector[uint64, E.EPOCHS_PER_SLASHINGS_VECTOR]
-        previous_epoch_participation: List[uint8, E.VALIDATOR_REGISTRY_LIMIT]
-        current_epoch_participation: List[uint8, E.VALIDATOR_REGISTRY_LIMIT]
+        previous_epoch_participation: ParticipationList[E.VALIDATOR_REGISTRY_LIMIT]
+        current_epoch_participation: ParticipationList[E.VALIDATOR_REGISTRY_LIMIT]
         justification_bits: Bitvector[4]
         previous_justified_checkpoint: Checkpoint
         current_justified_checkpoint: Checkpoint
