@@ -99,9 +99,11 @@ class BeaconChain:
         self.fork_choice = ForkChoice.from_anchor(
             genesis_root, genesis_state, spec, E
         )
-        # Justified balances come from the actual justified state when the
-        # snapshot cache still holds it.
-        self.fork_choice.state_provider = self._states.get
+        # Justified balances come from the actual justified state: snapshot
+        # cache fast path, then the store / block-replay fallback — so the
+        # tick-path checkpoint promotion can always materialize the justified
+        # state instead of keeping stale weights.
+        self.fork_choice.state_provider = self._justified_state_provider
         store.put_state(genesis_state.hash_tree_root(), genesis_state)
 
     # ------------------------------------------------------------------ head
@@ -130,6 +132,12 @@ class BeaconChain:
                 self._states[new_head] = state
             self.head_root = new_head
         return self.head_root
+
+    def _justified_state_provider(self, block_root: bytes):
+        state = self._states.get(block_root)
+        if state is not None:
+            return state
+        return self._load_state_for_block(block_root)
 
     def _load_state_for_block(self, block_root: bytes):
         """Fetch a block's post-state: hot/cold store by advertised state
@@ -479,20 +487,15 @@ class BeaconChain:
                     self.types, self.E
                 )
         if fork >= ForkName.BELLATRIX:
-            payload_cls = tf.ExecutionPayload
-            payload_kwargs = {}
-            if fork >= ForkName.CAPELLA:
-                from ..state_processing.capella import get_expected_withdrawals
-
-                payload_kwargs["withdrawals"] = get_expected_withdrawals(
-                    state, self.E
-                )
             if is_merge_transition_complete(state):
                 raise BlockError(
                     "post-merge payload production requires an execution "
                     "layer (get_payload) — wire chain.execution_layer"
                 )
-            body_kwargs["execution_payload"] = payload_cls(**payload_kwargs)
+            # Pre-merge blocks carry the default (execution-disabled)
+            # payload, which process_execution_payload never touches — so
+            # advertise NO withdrawals (they would never debit balances).
+            body_kwargs["execution_payload"] = tf.ExecutionPayload()
         block = tf.BeaconBlock(
             slot=slot,
             proposer_index=proposer,

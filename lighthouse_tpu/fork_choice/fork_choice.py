@@ -28,6 +28,14 @@ class InvalidAttestation(ForkChoiceError):
     pass
 
 
+class UnknownAncestor(InvalidAttestation):
+    """The head block's chain cannot be walked to the target epoch (the
+    ancestor is pre-finalization / pruned out of the proto-array). Distinct
+    from genuine FFG/LMD target inconsistency so callers can treat it as
+    queueable rather than invalid (spec: unknown blocks are ignored, not
+    rejected)."""
+
+
 class InvalidBlock(ForkChoiceError):
     pass
 
@@ -61,6 +69,10 @@ class ForkChoice:
         self.E = E
         # Effective balances of active validators at the justified state.
         self._justified_balances: list[int] = []
+        # Set when a checkpoint promotion couldn't materialize the justified
+        # state (tick-path with a cold cache); get_head retries the provider
+        # so head selection never keeps stale weights longer than necessary.
+        self._justified_balances_stale = False
         # Optional: block_root -> state, so justified balances come from the
         # actual justified checkpoint state (the reference's justified
         # balances cache); falls back to the importing block's state.
@@ -190,8 +202,8 @@ class ForkChoice:
             # balances (spec). The provider serves the actual justified
             # state; the importing block's post-state is a fallback whose
             # active set matches at the justified epoch in all but deep-reorg
-            # edge cases; with neither, keep the previous balances (tick-path
-            # promotion with a cold cache) — refreshed on next block import.
+            # edge cases; with neither, keep the previous balances but mark
+            # them stale so get_head retries the provider before selecting.
             balance_state = None
             if self.state_provider is not None:
                 balance_state = self.state_provider(justified.root)
@@ -201,6 +213,9 @@ class ForkChoice:
                 self._justified_balances = _active_balances(
                     balance_state, self.E, at_epoch=justified.epoch
                 )
+                self._justified_balances_stale = False
+            else:
+                self._justified_balances_stale = True
         if finalized.epoch > self.store.finalized_checkpoint.epoch:
             self.store.finalized_checkpoint = finalized
             self.proto.proto_array.maybe_prune(finalized.root)
@@ -278,6 +293,11 @@ class ForkChoice:
         checkpoint_block = self.proto.proto_array.ancestor_at_slot(
             data.beacon_block_root, target_slot
         )
+        if checkpoint_block is None:
+            raise UnknownAncestor(
+                "head block's chain does not reach the target epoch in the "
+                "proto-array (pre-finalization or pruned ancestor)"
+            )
         if checkpoint_block != data.target.root:
             raise InvalidAttestation(
                 "attestation target is inconsistent with the head block's "
@@ -295,6 +315,14 @@ class ForkChoice:
         """Recompute and return the canonical head root (fork_choice.rs:468)."""
         if current_slot is not None:
             self.on_tick(current_slot)
+        if self._justified_balances_stale and self.state_provider is not None:
+            jcp = self.store.justified_checkpoint
+            balance_state = self.state_provider(jcp.root)
+            if balance_state is not None:
+                self._justified_balances = _active_balances(
+                    balance_state, self.E, at_epoch=jcp.epoch
+                )
+                self._justified_balances_stale = False
         boost_amount = 0
         if self.store.proposer_boost_root != b"\x00" * 32:
             total = sum(self._justified_balances)

@@ -1,0 +1,386 @@
+"""Device Fq2/Fq6/Fq12 tower arithmetic for BLS12-381 pairings.
+
+Extends the batched limb kernels of `ops.bls381` (Fq/Fq2 over [..., 48]
+int32 Montgomery limbs) up the tower used by the optimal-ate pairing:
+
+    Fq2  = Fq[u]/(u²+1)          shape [..., 2, 48]
+    Fq6  = Fq2[v]/(v³−ξ), ξ=u+1  shape [..., 3, 2, 48]
+    Fq12 = Fq6[w]/(w²−v)         shape [..., 2, 3, 2, 48]
+
+All values are in Montgomery form. The formulas mirror the host tower in
+`crypto/bls12_381/fields.py` (the correctness oracle in tests) — Karatsuba
+Fq2/Fq6/Fq12 multiplication, tower inversion reduced to one Fq inversion
+(done by Fermat with a fixed 381-bit square-and-multiply scan; device code
+cannot use extended Euclid's data-dependent loop), and Frobenius via
+host-precomputed γ coefficients pushed as Montgomery limb constants.
+
+Role in the reference: these are the Fq12 field ops inside blst's pairing
+(vendored C/assembly, crypto/bls/src/impls/blst.rs:112) — here batched over
+the signature-set dimension and jit/shard-friendly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..crypto.bls12_381 import fields as HF
+from ..crypto.bls12_381.fields import P
+from .bls381 import (
+    NLIMB,
+    R_MONT,
+    _ONE_MONT,
+    int_to_limbs,
+    mod_add,
+    mod_sub,
+    mont_mul,
+)
+
+# ---------------------------------------------------------------------------
+# Constants (host ints → Montgomery limb arrays)
+# ---------------------------------------------------------------------------
+
+
+def fq_const(v: int) -> np.ndarray:
+    """Fq constant in Montgomery limb form, shape [48]."""
+    return int_to_limbs(v * R_MONT % P)
+
+
+def fq2_const(c) -> np.ndarray:
+    """Fq2 constant (c0, c1) → [2, 48] Montgomery limbs."""
+    return np.stack([fq_const(c[0]), fq_const(c[1])])
+
+
+_FQ_ZERO = np.zeros(NLIMB, dtype=np.int32)
+F2_ONE_DEV = np.stack([_ONE_MONT, _FQ_ZERO])
+F2_ZERO_DEV = np.zeros((2, NLIMB), dtype=np.int32)
+
+# Frobenius coefficients (derived on host in fields.py, not memorized):
+#   v^p  = γ6_1·v,  v^{2p} = γ6_2·v²,  w^p = γ12·w
+_G6_1_DEV = fq2_const(HF._G6_1)
+_G6_2_DEV = fq2_const(HF._G6_2)
+_G12_DEV = fq2_const(HF._G12)
+
+# Fixed exponent bits for Fermat inversion a^(p-2), LSB first.
+_PM2_BITS = np.array([(P - 2) >> i & 1 for i in range((P - 2).bit_length())],
+                     dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Fq2 ops ([..., 2, 48]); complements ops.bls381.DevFq2
+# ---------------------------------------------------------------------------
+
+
+def f2_add(a, b):
+    return jnp.stack(
+        [mod_add(a[..., 0, :], b[..., 0, :]), mod_add(a[..., 1, :], b[..., 1, :])],
+        axis=-2,
+    )
+
+
+def f2_sub(a, b):
+    return jnp.stack(
+        [mod_sub(a[..., 0, :], b[..., 0, :]), mod_sub(a[..., 1, :], b[..., 1, :])],
+        axis=-2,
+    )
+
+
+def f2_neg(a):
+    return f2_sub(jnp.zeros_like(a), a)
+
+
+def f2_conj(a):
+    c1 = mod_sub(jnp.zeros_like(a[..., 1, :]), a[..., 1, :])
+    return jnp.stack([a[..., 0, :], c1], axis=-2)
+
+
+def f2_mul(a, b):
+    """Karatsuba: 3 base mults."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    t0 = mont_mul(a0, b0)
+    t1 = mont_mul(a1, b1)
+    cross = mont_mul(mod_add(a0, a1), mod_add(b0, b1))
+    return jnp.stack(
+        [mod_sub(t0, t1), mod_sub(mod_sub(cross, t0), t1)], axis=-2
+    )
+
+
+def f2_sqr(a):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    c0 = mont_mul(mod_add(a0, a1), mod_sub(a0, a1))
+    t = mont_mul(a0, a1)
+    return jnp.stack([c0, mod_add(t, t)], axis=-2)
+
+
+def f2_mul_xi(a):
+    """ξ·a = (c0−c1) + (c0+c1)u."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([mod_sub(a0, a1), mod_add(a0, a1)], axis=-2)
+
+
+def f2_mul_fq(a, s):
+    """Fq2 × Fq scalar: s shape [..., 48]."""
+    return jnp.stack(
+        [mont_mul(a[..., 0, :], s), mont_mul(a[..., 1, :], s)], axis=-2
+    )
+
+
+def f2_double(a):
+    return f2_add(a, a)
+
+
+def f2_triple(a):
+    return f2_add(f2_add(a, a), a)
+
+
+def fq_inv(a):
+    """Fermat a^(p−2) over [..., 48] limbs — fixed 380-iteration scan with
+    static bits (no data-dependent control flow under jit)."""
+    bits = jnp.asarray(_PM2_BITS)
+    one = jnp.broadcast_to(jnp.asarray(_ONE_MONT), a.shape).astype(jnp.int32)
+
+    def body(carry, bit):
+        acc, base = carry
+        acc = jnp.where(bit > 0, mont_mul(acc, base), acc)
+        return (acc, mont_mul(base, base)), None
+
+    (acc, _), _ = lax.scan(body, (one, a), bits)
+    return acc
+
+
+def f2_inv(a):
+    """1/(a0+a1u) = (a0 − a1u)/(a0²+a1²): one Fq inversion."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    norm = mod_add(mont_mul(a0, a0), mont_mul(a1, a1))
+    ninv = fq_inv(norm)
+    return jnp.stack(
+        [mont_mul(a0, ninv), mod_sub(jnp.zeros_like(a0), mont_mul(a1, ninv))],
+        axis=-2,
+    )
+
+
+def f2_select(c, a, b):
+    """c: [...] bool."""
+    return jnp.where(c[..., None, None], a, b)
+
+
+def f2_is_zero(a):
+    return jnp.all(a == 0, axis=(-1, -2))
+
+
+# ---------------------------------------------------------------------------
+# Fq6 ops ([..., 3, 2, 48])
+# ---------------------------------------------------------------------------
+
+
+def _f6(c0, c1, c2):
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def f6_slots(a):
+    return a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+
+
+def f6_add(a, b):
+    a0, a1, a2 = f6_slots(a)
+    b0, b1, b2 = f6_slots(b)
+    return _f6(f2_add(a0, b0), f2_add(a1, b1), f2_add(a2, b2))
+
+
+def f6_sub(a, b):
+    a0, a1, a2 = f6_slots(a)
+    b0, b1, b2 = f6_slots(b)
+    return _f6(f2_sub(a0, b0), f2_sub(a1, b1), f2_sub(a2, b2))
+
+
+def f6_neg(a):
+    return f6_sub(jnp.zeros_like(a), a)
+
+
+def f6_mul(a, b):
+    """Toom-style 6-mult Fq6 product (mirrors host f6_mul)."""
+    a0, a1, a2 = f6_slots(a)
+    b0, b1, b2 = f6_slots(b)
+    t0 = f2_mul(a0, b0)
+    t1 = f2_mul(a1, b1)
+    t2 = f2_mul(a2, b2)
+    c0 = f2_add(
+        t0,
+        f2_mul_xi(f2_sub(f2_mul(f2_add(a1, a2), f2_add(b1, b2)), f2_add(t1, t2))),
+    )
+    c1 = f2_add(
+        f2_sub(f2_mul(f2_add(a0, a1), f2_add(b0, b1)), f2_add(t0, t1)),
+        f2_mul_xi(t2),
+    )
+    c2 = f2_add(
+        f2_sub(f2_mul(f2_add(a0, a2), f2_add(b0, b2)), f2_add(t0, t2)), t1
+    )
+    return _f6(c0, c1, c2)
+
+
+def f6_sqr(a):
+    return f6_mul(a, a)
+
+
+def f6_mul_by_v(a):
+    a0, a1, a2 = f6_slots(a)
+    return _f6(f2_mul_xi(a2), a0, a1)
+
+
+def f6_inv(a):
+    a0, a1, a2 = f6_slots(a)
+    c0 = f2_sub(f2_sqr(a0), f2_mul_xi(f2_mul(a1, a2)))
+    c1 = f2_sub(f2_mul_xi(f2_sqr(a2)), f2_mul(a0, a1))
+    c2 = f2_sub(f2_sqr(a1), f2_mul(a0, a2))
+    denom = f2_add(
+        f2_mul(a0, c0), f2_mul_xi(f2_add(f2_mul(a2, c1), f2_mul(a1, c2)))
+    )
+    t = f2_inv(denom)
+    return _f6(f2_mul(c0, t), f2_mul(c1, t), f2_mul(c2, t))
+
+
+def f6_frob(a):
+    a0, a1, a2 = f6_slots(a)
+    return _f6(
+        f2_conj(a0),
+        f2_mul(f2_conj(a1), jnp.asarray(_G6_1_DEV)),
+        f2_mul(f2_conj(a2), jnp.asarray(_G6_2_DEV)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fq12 ops ([..., 2, 3, 2, 48])
+# ---------------------------------------------------------------------------
+
+
+def _f12(a, b):
+    return jnp.stack([a, b], axis=-4)
+
+
+def f12_slots(a):
+    return a[..., 0, :, :, :], a[..., 1, :, :, :]
+
+
+def f12_ones(batch_shape) -> jnp.ndarray:
+    one = np.zeros((2, 3, 2, NLIMB), dtype=np.int32)
+    one[0, 0] = F2_ONE_DEV
+    return jnp.broadcast_to(jnp.asarray(one), (*batch_shape, 2, 3, 2, NLIMB))
+
+
+def f12_add(a, b):
+    a0, a1 = f12_slots(a)
+    b0, b1 = f12_slots(b)
+    return _f12(f6_add(a0, b0), f6_add(a1, b1))
+
+
+def f12_mul(a, b):
+    a0, a1 = f12_slots(a)
+    b0, b1 = f12_slots(b)
+    t0 = f6_mul(a0, b0)
+    t1 = f6_mul(a1, b1)
+    c0 = f6_add(t0, f6_mul_by_v(t1))
+    c1 = f6_sub(f6_sub(f6_mul(f6_add(a0, a1), f6_add(b0, b1)), t0), t1)
+    return _f12(c0, c1)
+
+
+def f12_sqr(a):
+    a0, a1 = f12_slots(a)
+    t = f6_mul(a0, a1)
+    c0 = f6_sub(
+        f6_sub(f6_mul(f6_add(a0, a1), f6_add(a0, f6_mul_by_v(a1))), t),
+        f6_mul_by_v(t),
+    )
+    c1 = f6_add(t, t)
+    return _f12(c0, c1)
+
+
+def f12_conj(a):
+    a0, a1 = f12_slots(a)
+    return _f12(a0, f6_neg(a1))
+
+
+def f12_inv(a):
+    a0, a1 = f12_slots(a)
+    t = f6_inv(f6_sub(f6_sqr(a0), f6_mul_by_v(f6_sqr(a1))))
+    return _f12(f6_mul(a0, t), f6_neg(f6_mul(a1, t)))
+
+
+def f12_frob(a):
+    a0, a1 = f12_slots(a)
+    b0 = f6_frob(a0)
+    b1 = f6_frob(a1)
+    g = jnp.asarray(_G12_DEV)
+    b1 = _f6(*[f2_mul(c, g) for c in f6_slots(b1)])
+    return _f12(b0, b1)
+
+
+def f12_frob2(a):
+    return f12_frob(f12_frob(a))
+
+
+def f12_select(c, a, b):
+    """c: [...] bool, broadcast over the 4 trailing axes."""
+    return jnp.where(c[..., None, None, None, None], a, b)
+
+
+def f12_is_one(a):
+    """Per-lane check a == 1 (Montgomery one in slot [0,0,0])."""
+    return jnp.all(a == f12_ones(a.shape[:-4]), axis=(-1, -2, -3, -4))
+
+
+def f12_pow_bits(a, bits: np.ndarray):
+    """a^e for a FIXED exponent given as LSB-first bit array (host numpy).
+    Square-and-multiply scan: branchless per-iteration select keeps the
+    graph small (vs static unrolling) while the trip count stays static."""
+    bits_d = jnp.asarray(bits.astype(np.int32))
+    one = f12_ones(a.shape[:-4])
+
+    def body(carry, bit):
+        acc, base = carry
+        acc = jnp.where(bit > 0, f12_mul(acc, base), acc)
+        return (acc, f12_sqr(base)), None
+
+    (acc, _), _ = lax.scan(body, (one, a), bits_d)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device conversion for tower elements
+# ---------------------------------------------------------------------------
+
+
+def f2_to_device(vals: list) -> np.ndarray:
+    """List of host Fq2 tuples → [n, 2, 48]."""
+    return np.stack([fq2_const(v) for v in vals]).astype(np.int32)
+
+
+def f12_to_device(vals: list) -> np.ndarray:
+    """List of host Fq12 tuples → [n, 2, 3, 2, 48]."""
+    out = np.zeros((len(vals), 2, 3, 2, NLIMB), dtype=np.int32)
+    for i, (lo, hi) in enumerate(vals):
+        for w, part in enumerate((lo, hi)):
+            for v, c in enumerate(part):
+                out[i, w, v] = fq2_const(c)
+    return out
+
+
+def f12_from_device(arr) -> list:
+    from .bls381 import limbs_to_int
+
+    host = np.asarray(arr).reshape(-1, 2, 3, 2, NLIMB)
+    rinv = pow(R_MONT, -1, P)
+    out = []
+    for row in host:
+        parts = []
+        for w in range(2):
+            parts.append(tuple(
+                (limbs_to_int(row[w, v, 0]) * rinv % P,
+                 limbs_to_int(row[w, v, 1]) * rinv % P)
+                for v in range(3)
+            ))
+        out.append((parts[0], parts[1]))
+    return out

@@ -44,11 +44,13 @@ def is_merge_transition_complete(state) -> bool:
 
 
 def is_merge_transition_block(state, body) -> bool:
+    """spec: !merge_complete and body.execution_payload != ExecutionPayload()
+    (full default-instance comparison, not just block_hash)."""
     payload = getattr(body, "execution_payload", None)
     return (
         not is_merge_transition_complete(state)
         and payload is not None
-        and payload.block_hash != b"\x00" * 32
+        and payload != type(payload)()
     )
 
 
@@ -72,7 +74,9 @@ def process_execution_payload(
     from .per_block import BlockProcessingError
 
     payload = body.execution_payload
-    if is_merge_transition_complete(state):
+    # Capella+ asserts the parent-hash linkage unconditionally (the merge
+    # transition is long complete); Bellatrix only once transition_complete.
+    if fork >= ForkName.CAPELLA or is_merge_transition_complete(state):
         if payload.parent_hash != state.latest_execution_payload_header.block_hash:
             raise BlockProcessingError("payload: parent hash mismatch")
     if payload.prev_randao != get_randao_mix(

@@ -228,6 +228,10 @@ def interop_genesis_state(
         apply_upgrades(
             state, build_types(E).fork_of_state(state), target_fork, spec, E
         )
+        # Fork-at-genesis networks set previous_version == current_version
+        # (reference consensus/state_processing/src/genesis.rs:58); leaving
+        # the phase0 genesis version would diverge fork digests.
+        state.fork.previous_version = state.fork.current_version
     return state
 
 
