@@ -71,11 +71,23 @@ class BeaconChain:
         spec: ChainSpec,
         E,
         slot_clock: SlotClock,
+        execution_layer=None,
+        kzg=None,
     ):
         from ..types.containers import build_types
 
         self.spec = spec
         self.E = E
+        # Engine-API client (execution_layer/src/lib.rs); None = pre-merge /
+        # consensus-only chain (payload checks fall back to the accept-all
+        # NoOpExecutionEngine).
+        self.execution_layer = execution_layer
+        # Deneb data availability (data_availability_checker.rs): blocks
+        # carrying blob commitments import only once their sidecars are
+        # KZG-verified. kzg=None chains reject commitment-carrying blocks.
+        from .data_availability import DataAvailabilityChecker
+
+        self.data_availability_checker = DataAvailabilityChecker(kzg, E)
         self.types = build_types(E)
         self.store = store
         self.store.types = self.types
@@ -304,6 +316,23 @@ class BeaconChain:
                 f"future block: slot {block.slot} > clock {current_slot}"
             )
 
+        # Deneb availability gate (beacon_chain.rs → data_availability_checker):
+        # commitment-carrying blocks need all sidecars KZG-verified first.
+        commitments = getattr(block.body, "blob_kzg_commitments", None)
+        if commitments:
+            from .data_availability import AvailabilityCheckError
+
+            try:
+                avail = self.data_availability_checker.put_block(
+                    block_root, signed_block, slot=current_slot
+                )
+            except AvailabilityCheckError as e:
+                raise BlockError(f"data availability: {e}") from e
+            if not avail.available:
+                raise BlockError(
+                    "blobs unavailable: feed sidecars via process_blob_sidecars"
+                )
+
         state = pre_state if pre_state is not None else self._pre_state_for(block)
         ctxt = ConsensusContext(block.slot)
         try:
@@ -316,6 +345,7 @@ class BeaconChain:
                 ctxt=ctxt,
                 block_root=block_root,
                 proposal_already_verified=proposal_verified,
+                execution_engine=self.execution_layer,
             )
         except BlockProcessingError as e:
             raise BlockError(f"invalid block: {e}") from e
@@ -343,6 +373,8 @@ class BeaconChain:
 
         self.recompute_head()
         self.op_pool.prune(self.head_state)
+        if commitments:
+            self.data_availability_checker.pop(block_root)
         self._prune_at_finality()
         return block_root
 
@@ -367,6 +399,7 @@ class BeaconChain:
         if finalized.epoch == 0:
             return
         finalized_slot = compute_start_slot_at_epoch(finalized.epoch, self.E)
+        self.data_availability_checker.prune_before(finalized_slot)
         droppable = [
             root
             for root, st in self._states.items()
@@ -419,6 +452,18 @@ class BeaconChain:
         self.apply_attestation_to_fork_choice(verified.indexed_attestation)
         self.op_pool.insert_attestation(attestation)
         return verified
+
+    def process_blob_sidecars(self, block_root: bytes, sidecars: list):
+        """KZG-verify and stage blob sidecars for a block (gossip/RPC blobs
+        path → data_availability_checker.put_blobs)."""
+        from .data_availability import AvailabilityCheckError
+
+        try:
+            return self.data_availability_checker.put_blobs(
+                block_root, sidecars, slot=self.slot_clock.now()
+            )
+        except AvailabilityCheckError as e:
+            raise BlockError(f"blob sidecars rejected: {e}") from e
 
     def process_attestation_batch(self, attestations) -> list:
         results = self.attestation_verifier.batch_verify_unaggregated(
@@ -487,15 +532,8 @@ class BeaconChain:
                     self.types, self.E
                 )
         if fork >= ForkName.BELLATRIX:
-            if is_merge_transition_complete(state):
-                raise BlockError(
-                    "post-merge payload production requires an execution "
-                    "layer (get_payload) — wire chain.execution_layer"
-                )
-            # Pre-merge blocks carry the default (execution-disabled)
-            # payload, which process_execution_payload never touches — so
-            # advertise NO withdrawals (they would never debit balances).
-            body_kwargs["execution_payload"] = tf.ExecutionPayload()
+            payload = self._produce_payload(state, fork, tf)
+            body_kwargs["execution_payload"] = payload
         block = tf.BeaconBlock(
             slot=slot,
             proposer_index=proposer,
@@ -517,6 +555,52 @@ class BeaconChain:
         )
         block.state_root = post.hash_tree_root()
         return block, post
+
+    def _produce_payload(self, state, fork, tf):
+        """Execution payload for block production (beacon_chain.rs get
+        execution payload → execution_layer get_payload, lib.rs:807).
+
+        Pre-merge with no execution layer: the default (execution-disabled)
+        payload — no withdrawals advertised since process_execution_payload
+        never runs on it. With an execution layer: a real payload built on
+        the head, carrying the expected withdrawals sweep for Capella+."""
+        from ..execution_layer import PayloadAttributes
+        from ..state_processing.bellatrix import (
+            compute_timestamp_at_slot,
+            is_merge_transition_complete,
+        )
+        from ..state_processing.accessors import get_randao_mix
+        from ..types.chain_spec import ForkName
+
+        merged = is_merge_transition_complete(state)
+        if self.execution_layer is None:
+            if merged:
+                raise BlockError(
+                    "post-merge payload production requires an execution "
+                    "layer (get_payload) — wire chain.execution_layer"
+                )
+            return tf.ExecutionPayload()
+
+        withdrawals = []
+        if fork >= ForkName.CAPELLA:
+            from ..state_processing.capella import get_expected_withdrawals
+
+            withdrawals = get_expected_withdrawals(state, self.E)
+        attributes = PayloadAttributes(
+            timestamp=compute_timestamp_at_slot(state, self.spec, self.E),
+            prev_randao=get_randao_mix(
+                state, get_current_epoch(state, self.E), self.E
+            ),
+            withdrawals=withdrawals,
+        )
+        # Post-merge (and Capella+, whose spec asserts the parent link
+        # unconditionally): build exactly on the state's execution header.
+        # Bellatrix pre-merge: None = let the EL choose the terminal block.
+        if merged or fork >= ForkName.CAPELLA:
+            parent_hash = state.latest_execution_payload_header.block_hash
+        else:
+            parent_hash = None
+        return self.execution_layer.get_payload(parent_hash, attributes, fork)
 
 
 def empty_sync_aggregate(types, E):

@@ -1,0 +1,135 @@
+"""Data-availability checker for Deneb blobs.
+
+Mirrors beacon_node/beacon_chain/src/data_availability_checker.rs: a block
+with blob KZG commitments may only be imported once every commitment has a
+matching, KZG-verified blob sidecar. Pending components are held per block
+root until the block imports (the overflow-LRU analog is a plain dict
+pruned at finalization — single-process scope).
+
+Sidecar validation mirrors the gossip rules (deneb/p2p-interface.md):
+index bound, the sidecar's signed block header must root to the block it
+claims (binding sidecars to blocks so a third party can't poison another
+block's pending set), and `verify_blob_kzg_proof_batch` over the sidecars
+(crypto/kzg/src/lib.rs:81-107 path). Full generalized-index inclusion
+proofs land with the merkle_proof component; until then the header-root
+binding covers the gossip-poisoning vector.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class AvailabilityCheckError(ValueError):
+    pass
+
+
+@dataclass
+class PendingComponents:
+    block: object | None = None
+    blobs: dict[int, object] = field(default_factory=dict)
+    inserted_at_slot: int = 0
+
+
+@dataclass
+class Availability:
+    """Import decision: either available (block + verified blobs) or
+    pending more components."""
+
+    available: bool
+    block: object | None = None
+    blobs: list | None = None
+
+
+class DataAvailabilityChecker:
+    def __init__(self, kzg, E):
+        self.kzg = kzg
+        self.E = E
+        self._pending: dict[bytes, PendingComponents] = {}
+
+    # -- sidecar verification -------------------------------------------------
+
+    def verify_blob_sidecars(self, sidecars: list, block_root: bytes) -> None:
+        """KZG-batch-verify sidecars for one block (gossip + RPC path)."""
+        if not sidecars:
+            return
+        if self.kzg is None:
+            raise AvailabilityCheckError("no KZG engine configured")
+        blobs, commitments, proofs = [], [], []
+        for sc in sidecars:
+            if int(sc.index) >= self.E.MAX_BLOBS_PER_BLOCK:
+                raise AvailabilityCheckError(f"blob index {sc.index} out of range")
+            header = getattr(sc, "signed_block_header", None)
+            if header is not None:
+                if header.message.hash_tree_root() != block_root:
+                    raise AvailabilityCheckError(
+                        "sidecar header does not root to this block"
+                    )
+            blobs.append(bytes(sc.blob))
+            commitments.append(bytes(sc.kzg_commitment))
+            proofs.append(bytes(sc.kzg_proof))
+        if not self.kzg.verify_blob_kzg_proof_batch(blobs, commitments, proofs):
+            raise AvailabilityCheckError("blob KZG batch verification failed")
+
+    # -- component accumulation -----------------------------------------------
+
+    def put_blobs(self, block_root: bytes, sidecars: list, slot: int = 0) -> Availability:
+        self.verify_blob_sidecars(sidecars, block_root)
+        pend = self._pending.setdefault(block_root, PendingComponents())
+        pend.inserted_at_slot = max(pend.inserted_at_slot, slot)
+        for sc in sidecars:
+            pend.blobs[int(sc.index)] = sc
+        return self.check_availability(block_root)
+
+    def put_block(self, block_root: bytes, signed_block, slot: int = 0) -> Availability:
+        pend = self._pending.setdefault(block_root, PendingComponents())
+        pend.inserted_at_slot = max(pend.inserted_at_slot, slot)
+        pend.block = signed_block
+        return self.check_availability(block_root)
+
+    def _required_commitments(self, signed_block) -> list:
+        return list(
+            getattr(signed_block.message.body, "blob_kzg_commitments", []) or []
+        )
+
+    def check_availability(self, block_root: bytes) -> Availability:
+        """Non-destructive: the entry stays pending until `pop` after a
+        successful import (so a failed import or early completion never
+        strands components)."""
+        pend = self._pending.get(block_root)
+        if pend is None or pend.block is None:
+            return Availability(available=False)
+        commitments = self._required_commitments(pend.block)
+        if len(pend.blobs) < len(commitments):
+            return Availability(available=False)
+        mismatched = [
+            i
+            for i, c in enumerate(commitments)
+            if i in pend.blobs
+            and bytes(pend.blobs[i].kzg_commitment) != bytes(c)
+        ]
+        if mismatched:
+            # drop poisoned indices so honest re-sends can complete the set
+            for i in mismatched:
+                del pend.blobs[i]
+            raise AvailabilityCheckError(
+                f"blob commitments at {mismatched} do not match the block"
+            )
+        if any(i not in pend.blobs for i in range(len(commitments))):
+            return Availability(available=False)
+        blobs = [pend.blobs[i] for i in range(len(commitments))]
+        return Availability(available=True, block=pend.block, blobs=blobs)
+
+    def pop(self, block_root: bytes) -> None:
+        """Forget a block's components after successful import."""
+        self._pending.pop(block_root, None)
+
+    def has_pending(self, block_root: bytes) -> bool:
+        return block_root in self._pending
+
+    def prune_before(self, slot: int) -> None:
+        """Drop pending components staged before `slot` (finalization-driven
+        — nothing older than the finalized slot can still import)."""
+        for r, pend in list(self._pending.items()):
+            if pend.inserted_at_slot < slot:
+                del self._pending[r]

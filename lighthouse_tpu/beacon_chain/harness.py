@@ -33,6 +33,9 @@ class BeaconChainHarness:
         E,
         validator_count: int = 64,
         store: HotColdDB | None = None,
+        execution_layer=None,
+        mock_execution_layer: bool = False,
+        genesis_modifier=None,
     ):
         self.spec = spec
         self.E = E
@@ -40,16 +43,26 @@ class BeaconChainHarness:
         genesis_state = interop_genesis_state(
             self.keypairs, HARNESS_GENESIS_TIME, b"\x42" * 32, spec, E
         )
+        if genesis_modifier is not None:
+            # pre-chain genesis customization (credentials, balances, …);
+            # roots are computed after, so the modified state IS genesis.
+            genesis_modifier(genesis_state)
         self.slot_clock = ManualSlotClock(
             genesis_time=HARNESS_GENESIS_TIME,
             seconds_per_slot=spec.seconds_per_slot,
         )
+        if mock_execution_layer and execution_layer is None:
+            from ..execution_layer import MockExecutionLayer
+            from ..types.containers import build_types
+
+            execution_layer = MockExecutionLayer(build_types(E), E)
         self.chain = BeaconChain(
             store=store if store is not None else HotColdDB(MemoryStore()),
             genesis_state=genesis_state,
             spec=spec,
             E=E,
             slot_clock=self.slot_clock,
+            execution_layer=execution_layer,
         )
 
     # -- signing ------------------------------------------------------------
