@@ -294,6 +294,14 @@ class BeaconChain:
         import_block): state transition with bulk signature verification,
         store write, fork-choice registration (block + its attestations),
         head recompute."""
+        from ..metrics import inc_counter, start_timer
+
+        with start_timer("beacon_block_import_seconds"):
+            root = self._process_block_inner(block_input)
+        inc_counter("beacon_blocks_imported_total")
+        return root
+
+    def _process_block_inner(self, block_input) -> bytes:
         pre_state = None
         if isinstance(block_input, GossipVerifiedBlock):
             signed_block = block_input.signed_block
@@ -582,7 +590,13 @@ class BeaconChain:
             return tf.ExecutionPayload()
 
         withdrawals = []
-        if fork >= ForkName.CAPELLA:
+        if fork >= ForkName.ELECTRA:
+            from ..state_processing.electra import get_expected_withdrawals_electra
+
+            withdrawals, _ = get_expected_withdrawals_electra(
+                state, self.spec, self.E
+            )
+        elif fork >= ForkName.CAPELLA:
             from ..state_processing.capella import get_expected_withdrawals
 
             withdrawals = get_expected_withdrawals(state, self.E)

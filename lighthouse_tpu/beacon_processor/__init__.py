@@ -1,0 +1,237 @@
+"""BeaconProcessor: the priority work-queue scheduler.
+
+Mirrors beacon_node/beacon_processor/src/lib.rs:1-39,96-130: a manager
+drains bounded per-kind queues in strict priority order onto a small worker
+pool; gossip attestations and aggregates are coalesced into batches of up
+to 64 (`:200-201,553-576`) so signature verification amortizes into one
+RLC batch — on this stack that batch is exactly what the device BLS kernel
+wants. A re-processing queue holds early/unknown-parent work for retry
+(work_reprocessing_queue.rs).
+
+The reference schedules tokio blocking tasks; here a thread pool plays that
+role — device work is batched, not threaded, so workers mostly marshal
+batches into the chain's batch entry points.
+"""
+
+from __future__ import annotations
+
+import enum
+import queue
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..metrics import inc_counter, set_gauge
+
+MAX_GOSSIP_ATTESTATION_BATCH_SIZE = 64
+MAX_GOSSIP_AGGREGATE_BATCH_SIZE = 64
+
+
+class WorkType(enum.IntEnum):
+    """Queue kinds, priority order (low value = drained first) — the Work
+    enum's ~32 variants collapse to the kinds this node implements."""
+
+    CHAIN_SEGMENT = 0
+    GOSSIP_BLOCK = 1
+    GOSSIP_BLOB_SIDECAR = 2
+    GOSSIP_AGGREGATE = 3
+    GOSSIP_ATTESTATION = 4
+    UNKNOWN_BLOCK_ATTESTATION = 5
+    API_REQUEST = 6
+    BACKFILL_SYNC = 7
+
+
+_QUEUE_BOUNDS = {
+    WorkType.CHAIN_SEGMENT: 64,
+    WorkType.GOSSIP_BLOCK: 1024,
+    WorkType.GOSSIP_BLOB_SIDECAR: 1024,
+    WorkType.GOSSIP_AGGREGATE: 4096,
+    WorkType.GOSSIP_ATTESTATION: 16384,
+    WorkType.UNKNOWN_BLOCK_ATTESTATION: 8192,
+    WorkType.API_REQUEST: 1024,
+    WorkType.BACKFILL_SYNC: 64,
+}
+
+_BATCHED = {
+    WorkType.GOSSIP_ATTESTATION: MAX_GOSSIP_ATTESTATION_BATCH_SIZE,
+    WorkType.GOSSIP_AGGREGATE: MAX_GOSSIP_AGGREGATE_BATCH_SIZE,
+}
+
+
+@dataclass
+class WorkEvent:
+    work_type: WorkType
+    item: object
+    # handler(item) for singletons; batch handler receives list[item] when
+    # the kind is batched.
+    handler: object = None
+
+
+@dataclass
+class _Queues:
+    by_type: dict = field(default_factory=lambda: {t: deque() for t in WorkType})
+
+    def push(self, ev: WorkEvent) -> bool:
+        q = self.by_type[ev.work_type]
+        if len(q) >= _QUEUE_BOUNDS[ev.work_type]:
+            return False
+        q.append(ev)
+        return True
+
+    def pop_next(self):
+        """Highest-priority work: one event, or a coalesced batch for the
+        batched kinds (lib.rs:553-576)."""
+        for t in WorkType:
+            q = self.by_type[t]
+            if not q:
+                continue
+            limit = _BATCHED.get(t)
+            if limit is None:
+                return t, [q.popleft()]
+            batch = []
+            while q and len(batch) < limit:
+                batch.append(q.popleft())
+            return t, batch
+        return None, []
+
+    def __len__(self):
+        return sum(len(q) for q in self.by_type.values())
+
+
+class BeaconProcessor:
+    def __init__(self, num_workers: int = 2, name: str = "beacon_processor"):
+        self._queues = _Queues()
+        self._cv = threading.Condition()
+        self._work = queue.Queue()  # manager → workers
+        self._shutdown = False
+        self._idle_workers = num_workers
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True, name=f"{name}-w{i}")
+            for i in range(num_workers)
+        ]
+        self._manager = threading.Thread(
+            target=self._manager_loop, daemon=True, name=f"{name}-mgr"
+        )
+        self._inflight = 0
+        self._done_cv = threading.Condition()
+        for w in self._workers:
+            w.start()
+        self._manager.start()
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, work_type: WorkType, item, handler) -> bool:
+        """Enqueue work; False (and a drop metric) when the queue is full —
+        the reference's backpressure behavior."""
+        ev = WorkEvent(work_type, item, handler)
+        with self._cv:
+            ok = self._queues.push(ev)
+            if ok:
+                self._cv.notify()
+        if not ok:
+            inc_counter(
+                "beacon_processor_dropped_total", kind=work_type.name.lower()
+            )
+        return ok
+
+    # -- manager / workers ----------------------------------------------------
+
+    def _manager_loop(self):
+        while True:
+            with self._cv:
+                while not self._queues.__len__() and not self._shutdown:
+                    self._cv.wait(timeout=0.1)
+                if self._shutdown and not len(self._queues):
+                    break
+                t, batch = self._queues.pop_next()
+                set_gauge("beacon_processor_queue_depth", len(self._queues))
+            if not batch:
+                continue
+            with self._done_cv:
+                self._inflight += 1
+            self._work.put((t, batch))
+
+    def _worker_loop(self):
+        while True:
+            got = self._work.get()
+            if got is None:
+                return
+            t, batch = got
+            try:
+                handler = batch[0].handler
+                if t in _BATCHED:
+                    handler([ev.item for ev in batch])
+                else:
+                    for ev in batch:
+                        (ev.handler or handler)(ev.item)
+                inc_counter(
+                    "beacon_processor_processed_total",
+                    amount=len(batch),
+                    kind=t.name.lower(),
+                )
+            except Exception:
+                inc_counter(
+                    "beacon_processor_errors_total", kind=t.name.lower()
+                )
+            finally:
+                with self._done_cv:
+                    self._inflight -= 1
+                    self._done_cv.notify_all()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def drain(self, timeout: float = 10.0):
+        """Block until every queued item has been processed (test helper)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        with self._done_cv:
+            while (
+                len(self._queues) or self._inflight
+            ) and time.monotonic() < deadline:
+                self._done_cv.wait(timeout=0.05)
+        return not len(self._queues) and not self._inflight
+
+    def shutdown(self):
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        self._manager.join(timeout=2)
+        for _ in self._workers:
+            self._work.put(None)
+        for w in self._workers:
+            w.join(timeout=2)
+
+
+class ReprocessQueue:
+    """Early/unknown-parent work held for retry (work_reprocessing_queue.rs):
+    attestations for unknown blocks re-fire when the block arrives; early
+    work re-fires at its slot."""
+
+    def __init__(self):
+        self._by_block_root: dict[bytes, list[WorkEvent]] = {}
+        self._by_slot: dict[int, list[WorkEvent]] = {}
+        self._lock = threading.Lock()
+
+    def hold_for_block(self, block_root: bytes, ev: WorkEvent):
+        with self._lock:
+            self._by_block_root.setdefault(block_root, []).append(ev)
+
+    def hold_for_slot(self, slot: int, ev: WorkEvent):
+        with self._lock:
+            self._by_slot.setdefault(slot, []).append(ev)
+
+    def block_imported(self, block_root: bytes, processor: BeaconProcessor):
+        with self._lock:
+            evs = self._by_block_root.pop(block_root, [])
+        for ev in evs:
+            processor.submit(ev.work_type, ev.item, ev.handler)
+        return len(evs)
+
+    def slot_started(self, slot: int, processor: BeaconProcessor):
+        with self._lock:
+            due = [s for s in self._by_slot if s <= slot]
+            evs = [ev for s in due for ev in self._by_slot.pop(s)]
+        for ev in evs:
+            processor.submit(ev.work_type, ev.item, ev.handler)
+        return len(evs)

@@ -114,9 +114,10 @@ class MockExecutionLayer(ExecutionLayer):
             ForkName.BELLATRIX: self.types.ExecutionPayload,
             ForkName.CAPELLA: self.types.ExecutionPayloadCapella,
             ForkName.DENEB: self.types.ExecutionPayloadDeneb,
+            ForkName.ELECTRA: self.types.ExecutionPayloadElectra,
         }.get(fork)
         if payload_cls is None:
-            payload_cls = self.types.ExecutionPayloadDeneb
+            payload_cls = self.types.ExecutionPayloadElectra
         number = parent_number + 1
         # one synthetic transaction so payloads are visibly non-empty
         tx = hashlib.sha256(b"tx" + number.to_bytes(8, "little")).digest()

@@ -1,0 +1,193 @@
+"""Prometheus-style metrics registry.
+
+The `common/lighthouse_metrics` analog (src/lib.rs:1-18): a process-global
+registry of counters/gauges/histograms with `start_timer` helpers, consumed
+by the http_metrics server's text exposition. Collectors are created lazily
+on first use (the reference's lazy_static pattern) so any subsystem can
+record without setup ordering."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+
+class Counter:
+    __slots__ = ("name", "help", "_values", "_lock")
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] += amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {_fmt_num(v)}")
+        return out
+
+
+class Gauge:
+    __slots__ = ("name", "help", "_values", "_lock")
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels):
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = value
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# TYPE {self.name} gauge"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {_fmt_num(v)}")
+        return out
+
+
+class Histogram:
+    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_total", "_lock")
+
+    def __init__(self, name: str, help_text: str = "", buckets=_DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float):
+        with self._lock:
+            self._sum += value
+            self._total += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def start_timer(self) -> "_Timer":
+        return _Timer(self)
+
+    @property
+    def count(self) -> int:
+        return self._total
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def expose(self) -> list[str]:
+        out = [f"# TYPE {self.name} histogram"]
+        cum = 0
+        for b, c in zip(self.buckets, self._counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{_fmt_num(b)}"}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self._total}')
+        out.append(f"{self.name}_sum {_fmt_num(self._sum)}")
+        out.append(f"{self.name}_count {self._total}")
+        return out
+
+
+class _Timer:
+    __slots__ = ("_hist", "_t0")
+
+    def __init__(self, hist: Histogram):
+        self._hist = hist
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> float:
+        dt = time.perf_counter() - self._t0
+        self._hist.observe(dt)
+        return dt
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _fmt_num(v: float) -> str:
+    if v == int(v):
+        return str(int(v))
+    return repr(v)
+
+
+class Registry:
+    def __init__(self):
+        self._collectors: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, help_text: str, **kw):
+        with self._lock:
+            c = self._collectors.get(name)
+            if c is None:
+                c = cls(name, help_text, **kw)
+                self._collectors[name] = c
+            elif not isinstance(c, cls):
+                raise TypeError(f"metric {name} already registered as {type(c).__name__}")
+            return c
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._get(Counter, name, help_text)
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._get(Gauge, name, help_text)
+
+    def histogram(self, name: str, help_text: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help_text, buckets=buckets)
+
+    def expose(self) -> str:
+        """Prometheus text exposition (http_metrics /metrics body)."""
+        lines = []
+        for name in sorted(self._collectors):
+            lines.extend(self._collectors[name].expose())
+        return "\n".join(lines) + "\n"
+
+
+# process-global default registry (lighthouse_metrics lazy_static analog)
+REGISTRY = Registry()
+
+
+def inc_counter(name: str, amount: float = 1.0, **labels):
+    REGISTRY.counter(name).inc(amount, **labels)
+
+
+def set_gauge(name: str, value: float, **labels):
+    REGISTRY.gauge(name).set(value, **labels)
+
+
+def observe(name: str, value: float):
+    REGISTRY.histogram(name).observe(value)
+
+
+def start_timer(name: str) -> _Timer:
+    return REGISTRY.histogram(name).start_timer()

@@ -1,0 +1,271 @@
+"""Incremental Merkleization with dirty-leaf tracking.
+
+The capability of the reference's `consensus/cached_tree_hash` crate
+(cache.rs:14-161: `update_leaves` phase 1, `update_merkle_root` phase 2,
+`lift_dirty`) re-designed around flat numpy layers instead of a pointer
+arena: every tree level is one contiguous [n_level, 32] uint8 array, leaf
+diffs are found with a single vectorized compare, and dirty paths are
+re-hashed level by level (`lift_dirty` == `np.unique(dirty >> 1)`).
+
+Layer sizing follows SSZ `merkleize`: layers cover next_pow_of_two(count)
+leaves; the remaining depth up to the type's limit is folded with
+ZERO_HASHES (those folds are recomputed per update — log2(limit) hashes).
+
+The BeaconState-level cache (`BeaconStateHashCache`) mirrors
+`BeaconState::update_tree_hash_cache` (consensus/types/src/beacon_state.rs:
+2002-2004 via milhouse): the big registry-shaped fields (validators,
+balances, participation, inactivity scores, the slot-indexed root vectors)
+each own a `TreeHashCache`; per-validator container roots memoize on the
+Validator object itself (invalidated by `Container.__setattr__`, carried
+across `copy()` since copies preserve field values). Everything else is
+recomputed per call — those fields are O(1)-sized.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from ..utils.hash import ZERO_HASHES, hash32_concat
+from .merkle import next_pow_of_two
+
+# full rebuilds are faster than path updates past this dirty fraction
+_REBUILD_FRACTION = 0.5
+_DEVICE_BUILD_THRESHOLD = 1 << 11
+
+
+def _hash_rows(pairs: np.ndarray) -> np.ndarray:
+    """[n, 64] uint8 → [n, 32] uint8 (hashlib loop — used for dirty paths,
+    where n is small)."""
+    out = np.empty((pairs.shape[0], 32), dtype=np.uint8)
+    for i in range(pairs.shape[0]):
+        out[i] = np.frombuffer(
+            hashlib.sha256(pairs[i].tobytes()).digest(), dtype=np.uint8
+        )
+    return out
+
+
+def _build_layers(leaves: np.ndarray) -> list[np.ndarray]:
+    """Full build: layers[0] = leaves (padded to pow2), layers[-1] = [1, 32].
+    Uses the device kernel for big trees, hashlib otherwise."""
+    n = leaves.shape[0]
+    full = next_pow_of_two(n)
+    if full != n:
+        leaves = np.vstack(
+            [leaves, np.zeros((full - n, 32), dtype=np.uint8)]
+        )
+    else:
+        # layer 0 is the committed copy — never alias (or inherit the
+        # read-only flag of) the caller's buffer
+        leaves = np.array(leaves, dtype=np.uint8, copy=True)
+    if full >= _DEVICE_BUILD_THRESHOLD:
+        import jax
+
+        from ..ops.sha256 import bytes_to_words, merkle_tree_levels
+
+        words = bytes_to_words(leaves.tobytes())
+        levels = merkle_tree_levels(jax.device_put(words))
+        # levels: [root, ..., leaves] as [m, 8] u32 big-endian words
+        return [
+            np.asarray(jax.device_get(lv))
+            .astype(">u4")
+            .view(np.uint8)
+            .reshape(-1, 32)
+            for lv in reversed(levels)
+        ]
+    layers = [leaves]
+    cur = leaves
+    while cur.shape[0] > 1:
+        cur = _hash_rows(cur.reshape(-1, 64))
+        layers.append(cur)
+    return layers
+
+
+class TreeHashCache:
+    """Incremental Merkle root over a leaf-chunk array with a static limit.
+
+    `update(leaves)` diffs against the committed leaves, re-hashes only
+    dirty paths, and returns the root at the type's limit depth."""
+
+    def __init__(self, limit_chunks: int):
+        self.limit = limit_chunks
+        self.depth = (next_pow_of_two(limit_chunks) - 1).bit_length()
+        self.layers: list[np.ndarray] | None = None
+        self.count = 0
+
+    def copy(self) -> "TreeHashCache":
+        out = TreeHashCache.__new__(TreeHashCache)
+        out.limit = self.limit
+        out.depth = self.depth
+        out.count = self.count
+        out.layers = (
+            None if self.layers is None else [a.copy() for a in self.layers]
+        )
+        return out
+
+    def _fold_to_depth(self) -> bytes:
+        root = self.layers[-1][0].tobytes()
+        sub_depth = len(self.layers) - 1
+        for level in range(sub_depth, self.depth):
+            root = hash32_concat(root, ZERO_HASHES[level])
+        return root
+
+    def update(self, leaves: np.ndarray) -> bytes:
+        """leaves: [n, 32] uint8 (n ≤ limit). Returns the merkle root
+        (zero-padded to the limit depth, no length mix)."""
+        n = leaves.shape[0]
+        if n > self.limit:
+            raise ValueError(f"{n} chunks exceeds limit {self.limit}")
+        if (
+            self.layers is None
+            or next_pow_of_two(n) != self.layers[0].shape[0]
+            or n < self.count
+        ):
+            # first build, pow2 growth, or shrink: rebuild
+            self.layers = _build_layers(leaves)
+            self.count = n
+            return self._fold_to_depth()
+
+        committed = self.layers[0]
+        dirty = np.nonzero((committed[:n] != leaves).any(axis=1))[0]
+        if n > self.count:
+            dirty = np.union1d(dirty, np.arange(self.count, n))
+        if dirty.size == 0:
+            self.count = n
+            return self._fold_to_depth()
+        if dirty.size > _REBUILD_FRACTION * max(n, 1):
+            self.layers = _build_layers(leaves)
+            self.count = n
+            return self._fold_to_depth()
+
+        committed[:n] = leaves
+        self.count = n
+        # phase 2 (update_merkle_root): lift dirty indices level by level
+        idx = np.unique(dirty >> 1)
+        for level in range(len(self.layers) - 1):
+            src = self.layers[level]
+            dst = self.layers[level + 1]
+            pairs = src.reshape(-1, 64)[idx]
+            dst[idx] = _hash_rows(pairs)
+            idx = np.unique(idx >> 1)
+        return self._fold_to_depth()
+
+
+# ---------------------------------------------------------------------------
+# Leaf extraction for the cached BeaconState fields
+# ---------------------------------------------------------------------------
+
+
+def _pack_uint64(values, limit_chunks: int) -> np.ndarray:
+    arr = np.asarray(values, dtype=np.uint64)
+    n_chunks = (arr.size + 3) // 4
+    buf = np.zeros(n_chunks * 4, dtype=np.uint64)
+    buf[: arr.size] = arr
+    return buf.view(np.uint8).reshape(-1, 32)  # little-endian hosts
+
+
+def _pack_bytes(data: bytes | bytearray) -> np.ndarray:
+    b = np.frombuffer(bytes(data), dtype=np.uint8)
+    n_chunks = max(1, (b.size + 31) // 32) if b.size else 0
+    buf = np.zeros(n_chunks * 32, dtype=np.uint8)
+    buf[: b.size] = b
+    return buf.reshape(-1, 32)
+
+
+def _pack_roots(roots: list[bytes]) -> np.ndarray:
+    if not roots:
+        return np.zeros((0, 32), dtype=np.uint8)
+    return np.frombuffer(b"".join(roots), dtype=np.uint8).reshape(-1, 32)
+
+
+def _validator_root(v) -> bytes:
+    """Per-validator container root, memoized on the object. Validator
+    fields are immutable scalars/bytes, so `Container.__setattr__` is the
+    only mutation path — it clears the memo."""
+    root = v.__dict__.get("_thc_root")
+    if root is None:
+        root = type(v).hash_tree_root_of(v)
+        v.__dict__["_thc_root"] = root
+    return root
+
+
+class BeaconStateHashCache:
+    """Per-state incremental hasher for the registry-scale fields."""
+
+    # field -> (leaf extractor, mix_in_length?)
+    LIST_FIELDS = {
+        "validators": (
+            lambda state, E: _pack_roots([_validator_root(v) for v in state.validators]),
+            True,
+        ),
+        "balances": (lambda state, E: _pack_uint64(state.balances, 0), True),
+        "previous_epoch_participation": (
+            lambda state, E: _pack_bytes(state.previous_epoch_participation),
+            True,
+        ),
+        "current_epoch_participation": (
+            lambda state, E: _pack_bytes(state.current_epoch_participation),
+            True,
+        ),
+        "inactivity_scores": (
+            lambda state, E: _pack_uint64(state.inactivity_scores, 0),
+            True,
+        ),
+    }
+    VECTOR_FIELDS = {
+        "block_roots": lambda state, E: _pack_roots(list(state.block_roots)),
+        "state_roots": lambda state, E: _pack_roots(list(state.state_roots)),
+        "randao_mixes": lambda state, E: _pack_roots(list(state.randao_mixes)),
+        "slashings": lambda state, E: _pack_uint64(state.slashings, 0),
+    }
+
+    def __init__(self):
+        self._caches: dict[str, TreeHashCache] = {}
+
+    def copy(self) -> "BeaconStateHashCache":
+        out = BeaconStateHashCache()
+        out._caches = {k: c.copy() for k, c in self._caches.items()}
+        return out
+
+    def _cache_for(self, fname: str, ftype) -> TreeHashCache:
+        c = self._caches.get(fname)
+        if c is None:
+            c = TreeHashCache(ftype.chunk_count())
+            self._caches[fname] = c
+        return c
+
+    def field_root(self, state, fname: str, ftype) -> bytes | None:
+        """Cached root for `fname`, or None if the field isn't cacheable."""
+        ent = self.LIST_FIELDS.get(fname)
+        if ent is not None and hasattr(state, fname):
+            extract, _ = ent
+            from .merkle import mix_in_length
+
+            cache = self._cache_for(fname, ftype)
+            root = cache.update(extract(state, None))
+            return mix_in_length(root, len(getattr(state, fname)))
+        ext = self.VECTOR_FIELDS.get(fname)
+        if ext is not None and hasattr(state, fname):
+            cache = self._cache_for(fname, ftype)
+            return cache.update(ext(state, None))
+        return None
+
+
+def cached_state_root(state) -> bytes:
+    """Drop-in `hash_tree_root` for BeaconState containers: big fields ride
+    the incremental caches (carried across `state.copy()`), the rest
+    recompute — the `update_tree_hash_cache` analog."""
+    cache = state.__dict__.get("_thc_cache")
+    if cache is None:
+        cache = BeaconStateHashCache()
+        state.__dict__["_thc_cache"] = cache
+    from .merkle import merkleize
+
+    chunks = []
+    for fname, ftype in state._fields.items():
+        root = cache.field_root(state, fname, ftype)
+        if root is None:
+            root = ftype.hash_tree_root_of(getattr(state, fname))
+        chunks.append(root)
+    return merkleize(chunks)

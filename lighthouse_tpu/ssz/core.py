@@ -746,6 +746,9 @@ class Container(SSZType, metaclass=_ContainerMeta):
         ftype = self._fields.get(name)
         if ftype is not None:
             value = ftype.coerce(value)
+            # field mutation invalidates this container's memoized root
+            # (cached_tree_hash: the per-validator root memo)
+            self.__dict__.pop("_thc_root", None)
         object.__setattr__(self, name, value)
 
     def __eq__(self, other):
@@ -758,10 +761,18 @@ class Container(SSZType, metaclass=_ContainerMeta):
         return f"{type(self).__name__}({inner})"
 
     def copy(self):
-        """Deep copy (containers/lists copied; bytes/ints shared — immutable)."""
+        """Deep copy (containers/lists copied; bytes/ints shared — immutable).
+        Tree-hash memos carry over: field values are equal by construction,
+        and the state-level cache deep-copies its numpy layers."""
         out = type(self).__new__(type(self))
         for fname, ftype in self._fields.items():
             out.__dict__[fname] = _deep_copy(ftype, getattr(self, fname))
+        memo = self.__dict__.get("_thc_root")
+        if memo is not None:
+            out.__dict__["_thc_root"] = memo
+        cache = self.__dict__.get("_thc_cache")
+        if cache is not None:
+            out.__dict__["_thc_cache"] = cache.copy()
         return out
 
     # -- SSZType protocol ---------------------------------------------------

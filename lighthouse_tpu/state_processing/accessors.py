@@ -329,6 +329,12 @@ def get_validator_churn_limit(state, spec: ChainSpec, E) -> int:
 
 
 def initiate_validator_exit(state, index: int, spec: ChainSpec, E):
+    if hasattr(state, "earliest_exit_epoch"):
+        # Electra: weight-denominated exit churn (EIP-7251)
+        from .electra import initiate_validator_exit_electra
+
+        initiate_validator_exit_electra(state, index, spec, E)
+        return
     v = state.validators[index]
     if v.exit_epoch != FAR_FUTURE_EPOCH:
         return
@@ -365,7 +371,9 @@ def slash_validator(
         v.withdrawable_epoch, epoch + E.EPOCHS_PER_SLASHINGS_VECTOR
     )
     state.slashings[epoch % E.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
-    if fork >= ForkName.BELLATRIX:
+    if fork >= ForkName.ELECTRA:
+        quotient = spec.min_slashing_penalty_quotient_electra
+    elif fork >= ForkName.BELLATRIX:
         quotient = E.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
     elif fork >= ForkName.ALTAIR:
         quotient = E.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
@@ -375,7 +383,12 @@ def slash_validator(
     proposer_index = get_beacon_proposer_index(state, E)
     if whistleblower_index is None:
         whistleblower_index = proposer_index
-    whistleblower_reward = v.effective_balance // E.WHISTLEBLOWER_REWARD_QUOTIENT
+    wb_quotient = (
+        spec.whistleblower_reward_quotient_electra
+        if fork >= ForkName.ELECTRA
+        else E.WHISTLEBLOWER_REWARD_QUOTIENT
+    )
+    whistleblower_reward = v.effective_balance // wb_quotient
     if fork >= ForkName.ALTAIR:
         from .altair import PROPOSER_WEIGHT, WEIGHT_DENOMINATOR
 

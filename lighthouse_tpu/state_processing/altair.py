@@ -555,6 +555,13 @@ def process_slashings_altair(state, E, fork: ForkName, arrays: EpochArrays | Non
     if not mask.any():
         return
     increment = E.EFFECTIVE_BALANCE_INCREMENT
+    if fork >= ForkName.ELECTRA:
+        # EIP-7251: per-increment penalty to stay exact at 2048-ETH maxeb
+        per_increment = adjusted // (total_balance // increment)
+        for index in np.nonzero(mask)[0]:
+            eb = int(arrays.effective_balance[index])
+            decrease_balance(state, int(index), per_increment * (eb // increment))
+        return
     for index in np.nonzero(mask)[0]:
         eb = int(arrays.effective_balance[index])
         penalty_numerator = eb // increment * adjusted
@@ -618,7 +625,18 @@ def process_epoch_altair(state, spec: ChainSpec, E, fork: ForkName):
     arrays = EpochArrays(state, E)
     process_slashings_altair(state, E, fork, arrays)
     process_eth1_data_reset(state, E)
-    process_effective_balance_updates(state, E)
+    if fork >= ForkName.ELECTRA:
+        from .electra import (
+            process_effective_balance_updates_electra,
+            process_pending_balance_deposits,
+            process_pending_consolidations,
+        )
+
+        process_pending_balance_deposits(state, spec, E)
+        process_pending_consolidations(state, spec, E)
+        process_effective_balance_updates_electra(state, spec, E)
+    else:
+        process_effective_balance_updates(state, E)
     process_slashings_reset(state, E)
     process_randao_mixes_reset(state, E)
     if fork >= ForkName.CAPELLA:

@@ -106,16 +106,20 @@ def process_execution_payload(
         ForkName.BELLATRIX: t.ExecutionPayloadHeader,
         ForkName.CAPELLA: t.ExecutionPayloadHeaderCapella,
         ForkName.DENEB: t.ExecutionPayloadHeaderDeneb,
+        ForkName.ELECTRA: t.ExecutionPayloadHeaderElectra,
     }[fork]
+    _LIST_ROOTS = {
+        "transactions_root": "transactions",
+        "withdrawals_root": "withdrawals",
+        "deposit_receipts_root": "deposit_receipts",
+        "withdrawal_requests_root": "withdrawal_requests",
+    }
     fields = {}
     for fname in header_cls._fields:
-        if fname == "transactions_root":
-            fields[fname] = type(payload)._fields["transactions"].hash_tree_root_of(
-                payload.transactions
-            )
-        elif fname == "withdrawals_root":
-            fields[fname] = type(payload)._fields["withdrawals"].hash_tree_root_of(
-                payload.withdrawals
+        src = _LIST_ROOTS.get(fname)
+        if src is not None:
+            fields[fname] = type(payload)._fields[src].hash_tree_root_of(
+                getattr(payload, src)
             )
         else:
             fields[fname] = getattr(payload, fname)

@@ -75,10 +75,18 @@ def get_expected_withdrawals(state, E) -> list:
     return withdrawals
 
 
-def process_withdrawals(state, execution_payload, E):
+def process_withdrawals(state, execution_payload, E, spec: ChainSpec | None = None):
     from .per_block import BlockProcessingError
 
-    expected = get_expected_withdrawals(state, E)
+    partial_count = 0
+    if hasattr(state, "pending_partial_withdrawals"):
+        # Electra: matured pending partials lead the sweep and are popped
+        from .electra import get_expected_withdrawals_electra
+
+        assert spec is not None, "electra withdrawals need the chain spec"
+        expected, partial_count = get_expected_withdrawals_electra(state, spec, E)
+    else:
+        expected = get_expected_withdrawals(state, E)
     actual = list(execution_payload.withdrawals)
     if len(actual) != len(expected):
         raise BlockProcessingError(
@@ -89,6 +97,10 @@ def process_withdrawals(state, execution_payload, E):
             raise BlockProcessingError("withdrawals: mismatch with expected sweep")
         decrease_balance(state, want.validator_index, want.amount)
 
+    if partial_count:
+        state.pending_partial_withdrawals = state.pending_partial_withdrawals[
+            partial_count:
+        ]
     if expected:
         state.next_withdrawal_index = expected[-1].index + 1
     n = len(state.validators)

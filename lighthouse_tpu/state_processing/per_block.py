@@ -295,7 +295,9 @@ def _per_block_processing_inner(
             if fork >= ForkName.CAPELLA:
                 from .capella import process_withdrawals
 
-                process_withdrawals(state, block.body.execution_payload, E)
+                process_withdrawals(
+                    state, block.body.execution_payload, E, spec=spec
+                )
             process_execution_payload(
                 state, block.body, spec, E, fork, engine=execution_engine
             )
@@ -394,10 +396,16 @@ def process_operations(
         from ..types.containers import build_types
 
         fork = build_types(E).fork_of_state(state)
-    # Deposit count check
+    # Deposit count check. Electra (EIP-6110): eth1-bridge deposits stop at
+    # deposit_receipts_start_index — the eth1 queue drains only up to it.
+    eth1_deposit_count = state.eth1_data.deposit_count
+    if fork >= ForkName.ELECTRA:
+        eth1_deposit_count = min(
+            eth1_deposit_count, state.deposit_receipts_start_index
+        )
     expected_deposits = min(
         E.MAX_DEPOSITS,
-        state.eth1_data.deposit_count - state.eth1_deposit_index,
+        max(0, eth1_deposit_count - state.eth1_deposit_index),
     )
     if len(body.deposits) != expected_deposits:
         raise BlockProcessingError(
@@ -429,6 +437,20 @@ def process_operations(
             process_bls_to_execution_change(
                 state, change, spec, E, verify_signatures
             )
+    if fork >= ForkName.ELECTRA:
+        from .bellatrix import is_execution_enabled
+        from .electra import (
+            process_deposit_receipt,
+            process_execution_layer_withdrawal_request,
+        )
+
+        if is_execution_enabled(state, body):
+            # spec operation order: deposit receipts, then withdrawal
+            # requests — a same-block request may target a receipt's validator
+            for receipt in body.execution_payload.deposit_receipts:
+                process_deposit_receipt(state, receipt, spec, E)
+            for req in body.execution_payload.withdrawal_requests:
+                process_execution_layer_withdrawal_request(state, req, spec, E)
 
 
 def process_proposer_slashing(state, ps, spec, E, verify_signatures: bool):
@@ -566,9 +588,21 @@ def process_deposit(
 
 
 def apply_deposit(state, data, spec: ChainSpec, E, signature_verified: bool = False):
+    # Electra (EIP-7251): deposits flow through the pending-balance queue
+    # (weight-denominated churn) instead of crediting balances directly.
+    electra = hasattr(state, "pending_balance_deposits")
     index = _validator_index_by_pubkey(state, data.pubkey)
     if index is not None:
-        increase_balance(state, index, data.amount)
+        if electra:
+            from ..types.containers import build_types
+
+            state.pending_balance_deposits.append(
+                build_types(E).PendingBalanceDeposit(
+                    index=index, amount=data.amount
+                )
+            )
+        else:
+            increase_balance(state, index, data.amount)
         return
     # New validator: the deposit signature is checked individually with the
     # deposit domain; an invalid signature skips the deposit (does not fail
@@ -592,14 +626,23 @@ def add_validator_to_registry(state, data, E):
 
     t = build_types(E)
     amount = data.amount
+    electra = hasattr(state, "pending_balance_deposits")
+    if electra:
+        # EIP-7251: new validators enter with zero balance; the deposited
+        # amount rides the pending-balance queue.
+        effective = 0
+        balance = 0
+    else:
+        effective = min(
+            amount - amount % E.EFFECTIVE_BALANCE_INCREMENT,
+            E.MAX_EFFECTIVE_BALANCE,
+        )
+        balance = amount
     state.validators.append(
         t.Validator(
             pubkey=data.pubkey,
             withdrawal_credentials=data.withdrawal_credentials,
-            effective_balance=min(
-                amount - amount % E.EFFECTIVE_BALANCE_INCREMENT,
-                E.MAX_EFFECTIVE_BALANCE,
-            ),
+            effective_balance=effective,
             slashed=False,
             activation_eligibility_epoch=FAR_FUTURE_EPOCH,
             activation_epoch=FAR_FUTURE_EPOCH,
@@ -607,7 +650,13 @@ def add_validator_to_registry(state, data, E):
             withdrawable_epoch=FAR_FUTURE_EPOCH,
         )
     )
-    state.balances.append(amount)
+    state.balances.append(balance)
+    if electra:
+        state.pending_balance_deposits.append(
+            t.PendingBalanceDeposit(
+                index=len(state.validators) - 1, amount=amount
+            )
+        )
     # Altair+ registries carry parallel per-validator lists.
     if hasattr(state, "previous_epoch_participation"):
         state.previous_epoch_participation.append(0)
@@ -638,4 +687,10 @@ def process_voluntary_exit(state, signed_exit, spec, E, verify_signatures: bool)
         state, signed_exit, spec, E
     ).verify():
         raise BlockProcessingError("exit: bad signature")
+    if hasattr(state, "pending_partial_withdrawals"):
+        # Electra: only exit when no pending partial withdrawals remain
+        from .electra import get_pending_balance_to_withdraw
+
+        if get_pending_balance_to_withdraw(state, exit_msg.validator_index) != 0:
+            raise BlockProcessingError("exit: pending partial withdrawals")
     initiate_validator_exit(state, exit_msg.validator_index, spec, E)

@@ -37,16 +37,18 @@ BASE_REWARDS_PER_EPOCH = 4
 def process_epoch(state, spec: ChainSpec, E):
     """Epoch transition, fork-dispatched (per_epoch_processing.rs:44-52):
     phase0 multi-pass below; Altair+ the fused vectorized pass."""
+    from ..metrics import start_timer
     from ..types.chain_spec import ForkName
     from ..types.containers import build_types
 
     fork = build_types(E).fork_of_state(state)
-    if fork >= ForkName.ALTAIR:
-        from .altair import process_epoch_altair
+    with start_timer("epoch_transition_seconds"):
+        if fork >= ForkName.ALTAIR:
+            from .altair import process_epoch_altair
 
-        process_epoch_altair(state, spec, E, fork)
-        return
-    process_epoch_phase0(state, spec, E)
+            process_epoch_altair(state, spec, E, fork)
+        else:
+            process_epoch_phase0(state, spec, E)
 
 
 def process_epoch_phase0(state, spec: ChainSpec, E):
@@ -327,9 +329,22 @@ def process_rewards_and_penalties(state, spec: ChainSpec, E):
 
 
 def process_registry_updates(state, spec: ChainSpec, E):
+    from ..types.chain_spec import ForkName
+    from ..types.containers import build_types
+
+    fork = build_types(E).fork_of_state(state)
     current = get_current_epoch(state, E)
+    electra = fork >= ForkName.ELECTRA
     for index, v in enumerate(state.validators):
-        if is_eligible_for_activation_queue(v, E):
+        if electra:
+            # EIP-7251: eligibility at MIN_ACTIVATION_BALANCE
+            eligible = (
+                v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+                and v.effective_balance >= spec.min_activation_balance
+            )
+        else:
+            eligible = is_eligible_for_activation_queue(v, E)
+        if eligible:
             v.activation_eligibility_epoch = current + 1
         if is_active_validator(v, current) and v.effective_balance <= spec.ejection_balance:
             initiate_validator_exit(state, index, spec, E)
@@ -341,12 +356,14 @@ def process_registry_updates(state, spec: ChainSpec, E):
         ),
         key=lambda i: (state.validators[i].activation_eligibility_epoch, i),
     )
-    # Deneb (EIP-7514) caps the activation churn; exit churn is uncapped.
-    from ..types.containers import build_types
-
-    fork = build_types(E).fork_of_state(state)
-    active_count = len(get_active_validator_indices(state, current))
-    limit = spec.activation_churn_limit(active_count, fork)
+    if electra:
+        # EIP-7251: activations are unbounded by count — the balance churn
+        # is enforced upstream by the pending-deposit queue.
+        limit = len(activation_queue)
+    else:
+        # Deneb (EIP-7514) caps the activation churn; exit churn is uncapped.
+        active_count = len(get_active_validator_indices(state, current))
+        limit = spec.activation_churn_limit(active_count, fork)
     for index in activation_queue[:limit]:
         state.validators[index].activation_epoch = compute_activation_exit_epoch(
             current, E

@@ -143,11 +143,18 @@ def upgrade_to_deneb(state, spec: ChainSpec, E):
     _bump_fork(state, t, spec.deneb_fork_version, epoch)
 
 
+def _upgrade_to_electra(state, spec: ChainSpec, E):
+    from .electra import upgrade_to_electra
+
+    upgrade_to_electra(state, spec, E)
+
+
 UPGRADES = {
     ForkName.ALTAIR: upgrade_to_altair,
     ForkName.BELLATRIX: upgrade_to_bellatrix,
     ForkName.CAPELLA: upgrade_to_capella,
     ForkName.DENEB: upgrade_to_deneb,
+    ForkName.ELECTRA: _upgrade_to_electra,
 }
 
 _ORDER = [
@@ -156,6 +163,7 @@ _ORDER = [
     ForkName.BELLATRIX,
     ForkName.CAPELLA,
     ForkName.DENEB,
+    ForkName.ELECTRA,
 ]
 
 

@@ -10,17 +10,39 @@ database manager."""
 
 from __future__ import annotations
 
-import pickle
-
+from ..types.chain_spec import ForkName
 from .kv import DBColumn, ItemStore, MemoryStore
 
 SPLIT_KEY = b"split"
 HEAD_KEY = b"head"
 GENESIS_KEY = b"genesis"
 FORK_CHOICE_KEY = b"fork_choice"
+SCHEMA_VERSION_KEY = b"schema"
+
+# On-disk schema version (store/src/lib.rs CURRENT_SCHEMA_VERSION analog).
+# Bump on any layout change; `open` detects mismatches so a migration (or a
+# refusal to run) happens instead of silent misreads.
+CURRENT_SCHEMA_VERSION = 1
+
+# Stable 1-byte fork tags prefixed to stored states/blocks so decode picks
+# the right SSZ variant (the reference keys this off slot + spec; an explicit
+# tag keeps the store self-describing). Append-only list.
+_FORK_TAGS = [
+    ForkName.PHASE0,
+    ForkName.ALTAIR,
+    ForkName.BELLATRIX,
+    ForkName.CAPELLA,
+    ForkName.DENEB,
+    ForkName.ELECTRA,
+]
+_TAG_OF_FORK = {f: i for i, f in enumerate(_FORK_TAGS)}
 
 
 class StoreError(ValueError):
+    pass
+
+
+class SchemaVersionError(StoreError):
     pass
 
 
@@ -30,12 +52,54 @@ class HotColdDB:
         self.cold = cold if cold is not None else MemoryStore()
         self.types = types  # SimpleNamespace from build_types, for SSZ codecs
         self._split_slot = 0
+        self._check_schema_version()
+
+    def _check_schema_version(self):
+        raw = self.hot.get(DBColumn.BEACON_META, SCHEMA_VERSION_KEY)
+        if raw is None:
+            # Stamp only a genuinely fresh store. A populated store with no
+            # version key predates schema tagging — refuse instead of
+            # misreading its untagged values.
+            if self.hot.keys(DBColumn.BEACON_BLOCK) or self.hot.keys(
+                DBColumn.BEACON_STATE
+            ):
+                raise SchemaVersionError(
+                    "store has data but no schema version key (pre-v1 "
+                    "layout) — run the database manager migration"
+                )
+            self.hot.put(
+                DBColumn.BEACON_META,
+                SCHEMA_VERSION_KEY,
+                CURRENT_SCHEMA_VERSION.to_bytes(8, "little"),
+            )
+            return
+        found = int.from_bytes(raw, "little")
+        if found != CURRENT_SCHEMA_VERSION:
+            raise SchemaVersionError(
+                f"on-disk schema v{found} != supported v{CURRENT_SCHEMA_VERSION}"
+                " — run the database manager migration"
+            )
+
+    # -- fork-tagged SSZ codecs ---------------------------------------------
+
+    def _encode(self, obj, fork: ForkName) -> bytes:
+        return bytes([_TAG_OF_FORK[fork]]) + obj.serialize()
+
+    def _decode(self, data: bytes, kind: str):
+        tag = data[0]
+        if tag >= len(_FORK_TAGS):
+            raise StoreError(f"unknown fork tag {tag}")
+        tf = self.types.types_for_fork(_FORK_TAGS[tag])
+        return getattr(tf, kind).deserialize(data[1:])
 
     # -- blocks ------------------------------------------------------------
 
     def put_block(self, block_root: bytes, signed_block):
+        fork = self.types.fork_of_block(signed_block.message)
         self.hot.put(
-            DBColumn.BEACON_BLOCK, block_root, signed_block.serialize()
+            DBColumn.BEACON_BLOCK,
+            block_root,
+            self._encode(signed_block, fork),
         )
 
     def get_block(self, block_root: bytes):
@@ -44,7 +108,7 @@ class HotColdDB:
             data = self.cold.get(DBColumn.BEACON_BLOCK, block_root)
         if data is None:
             return None
-        return self.types.SignedBeaconBlock.deserialize(data)
+        return self._decode(data, "SignedBeaconBlock")
 
     def block_exists(self, block_root: bytes) -> bool:
         return self.hot.exists(DBColumn.BEACON_BLOCK, block_root) or self.cold.exists(
@@ -54,7 +118,10 @@ class HotColdDB:
     # -- states ------------------------------------------------------------
 
     def put_state(self, state_root: bytes, state):
-        self.hot.put(DBColumn.BEACON_STATE, state_root, state.serialize())
+        fork = self.types.fork_of_state(state)
+        self.hot.put(
+            DBColumn.BEACON_STATE, state_root, self._encode(state, fork)
+        )
 
     def get_state(self, state_root: bytes):
         data = self.hot.get(DBColumn.BEACON_STATE, state_root)
@@ -62,7 +129,7 @@ class HotColdDB:
             data = self.cold.get(DBColumn.BEACON_STATE, state_root)
         if data is None:
             return None
-        return self.types.BeaconState.deserialize(data)
+        return self._decode(data, "BeaconState")
 
     def delete_state(self, state_root: bytes):
         self.hot.delete(DBColumn.BEACON_STATE, state_root)

@@ -115,6 +115,17 @@ class ChainSpec:
     churn_limit_quotient: int = 65536
     max_per_epoch_activation_churn_limit: int = 8
 
+    # --- Electra (EIP-7251 maxeb / churn; chain_spec.rs:186-191) ----------
+    min_activation_balance: int = 32 * 10**9
+    max_effective_balance_electra: int = 2048 * 10**9
+    compounding_withdrawal_prefix_byte: int = 0x02
+    min_per_epoch_churn_limit_electra: int = 128 * 10**9
+    max_per_epoch_activation_exit_churn_limit: int = 256 * 10**9
+    min_slashing_penalty_quotient_electra: int = 4096
+    whistleblower_reward_quotient_electra: int = 4096
+    unset_deposit_receipts_start_index: int = 2**64 - 1
+    full_exit_request_amount: int = 0
+
     # --- Fork choice ------------------------------------------------------
     proposer_score_boost: int = 40
     reorg_head_weight_threshold: int = 20

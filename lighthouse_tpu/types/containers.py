@@ -195,6 +195,13 @@ def build_types(E: type) -> SimpleNamespace:
         current_justified_checkpoint: Checkpoint
         finalized_checkpoint: Checkpoint
 
+        def hash_tree_root(self) -> bytes:
+            # incremental per-field caches for the registry-scale fields
+            # (cached_tree_hash analog; beacon_state.rs:2002-2004)
+            from ..ssz.cached_tree_hash import cached_state_root
+
+            return cached_state_root(self)
+
     class AggregateAndProof(Container):
         aggregator_index: uint64
         aggregate: Attestation
@@ -416,6 +423,81 @@ def build_types(E: type) -> SimpleNamespace:
             Bytes32, E.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH
         ]
 
+    # -- Electra (EIP-7251 maxeb / EIP-7002 EL withdrawals / EIP-6110
+    #    deposit receipts; reference consensus/types/src/{deposit_receipt,
+    #    execution_layer_withdrawal_request,pending_*}.rs) ------------------
+
+    class DepositReceipt(Container):
+        pubkey: BLSPubkey
+        withdrawal_credentials: Bytes32
+        amount: uint64
+        signature: BLSSignature
+        index: uint64
+
+    class ExecutionLayerWithdrawalRequest(Container):
+        source_address: ExecutionAddress
+        validator_pubkey: BLSPubkey
+        amount: uint64
+
+    class PendingBalanceDeposit(Container):
+        index: uint64
+        amount: uint64
+
+    class PendingPartialWithdrawal(Container):
+        index: uint64
+        amount: uint64
+        withdrawable_epoch: uint64
+
+    class PendingConsolidation(Container):
+        source_index: uint64
+        target_index: uint64
+
+    class Consolidation(Container):
+        source_index: uint64
+        target_index: uint64
+        epoch: uint64
+
+    class SignedConsolidation(Container):
+        message: Consolidation
+        signature: BLSSignature
+
+    class ExecutionPayloadElectra(ExecutionPayloadDeneb):
+        deposit_receipts: List[DepositReceipt, E.MAX_DEPOSIT_RECEIPTS_PER_PAYLOAD]
+        withdrawal_requests: List[
+            ExecutionLayerWithdrawalRequest, E.MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD
+        ]
+
+    class ExecutionPayloadHeaderElectra(ExecutionPayloadHeaderDeneb):
+        deposit_receipts_root: Bytes32
+        withdrawal_requests_root: Bytes32
+
+    class BeaconBlockBodyElectra(BeaconBlockBodyDeneb):
+        execution_payload: ExecutionPayloadElectra
+
+    class BeaconBlockElectra(BeaconBlock):
+        body: BeaconBlockBodyElectra
+
+    class SignedBeaconBlockElectra(SignedBeaconBlock):
+        message: BeaconBlockElectra
+
+    class BeaconStateElectra(BeaconStateDeneb):
+        latest_execution_payload_header: ExecutionPayloadHeaderElectra
+        deposit_receipts_start_index: uint64
+        deposit_balance_to_consume: uint64
+        exit_balance_to_consume: uint64
+        earliest_exit_epoch: uint64
+        consolidation_balance_to_consume: uint64
+        earliest_consolidation_epoch: uint64
+        pending_balance_deposits: List[
+            PendingBalanceDeposit, E.PENDING_BALANCE_DEPOSITS_LIMIT
+        ]
+        pending_partial_withdrawals: List[
+            PendingPartialWithdrawal, E.PENDING_PARTIAL_WITHDRAWALS_LIMIT
+        ]
+        pending_consolidations: List[
+            PendingConsolidation, E.PENDING_CONSOLIDATIONS_LIMIT
+        ]
+
     # -- Fork registry (the superstruct analog) ----------------------------
 
     forks = {
@@ -458,6 +540,14 @@ def build_types(E: type) -> SimpleNamespace:
             SignedBeaconBlock=SignedBeaconBlockDeneb,
             ExecutionPayload=ExecutionPayloadDeneb,
             ExecutionPayloadHeader=ExecutionPayloadHeaderDeneb,
+        ),
+        ForkName.ELECTRA: SimpleNamespace(
+            BeaconState=BeaconStateElectra,
+            BeaconBlock=BeaconBlockElectra,
+            BeaconBlockBody=BeaconBlockBodyElectra,
+            SignedBeaconBlock=SignedBeaconBlockElectra,
+            ExecutionPayload=ExecutionPayloadElectra,
+            ExecutionPayloadHeader=ExecutionPayloadHeaderElectra,
         ),
     }
 
@@ -553,4 +643,18 @@ def build_types(E: type) -> SimpleNamespace:
         SignedBeaconBlockDeneb=SignedBeaconBlockDeneb,
         BlobIdentifier=BlobIdentifier,
         BlobSidecar=BlobSidecar,
+        # electra
+        DepositReceipt=DepositReceipt,
+        ExecutionLayerWithdrawalRequest=ExecutionLayerWithdrawalRequest,
+        PendingBalanceDeposit=PendingBalanceDeposit,
+        PendingPartialWithdrawal=PendingPartialWithdrawal,
+        PendingConsolidation=PendingConsolidation,
+        Consolidation=Consolidation,
+        SignedConsolidation=SignedConsolidation,
+        ExecutionPayloadElectra=ExecutionPayloadElectra,
+        ExecutionPayloadHeaderElectra=ExecutionPayloadHeaderElectra,
+        BeaconStateElectra=BeaconStateElectra,
+        BeaconBlockElectra=BeaconBlockElectra,
+        BeaconBlockBodyElectra=BeaconBlockBodyElectra,
+        SignedBeaconBlockElectra=SignedBeaconBlockElectra,
     )

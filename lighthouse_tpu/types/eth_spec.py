@@ -87,6 +87,15 @@ class EthSpec:
     MAX_BLOBS_PER_BLOCK = 6
     KZG_COMMITMENT_INCLUSION_PROOF_DEPTH = 17
 
+    # --- Electra (EIP-7251/7002/6110; eth_spec.rs Electra associated
+    # types in the reference) ----------------------------------------------
+    PENDING_BALANCE_DEPOSITS_LIMIT = 2**27
+    PENDING_PARTIAL_WITHDRAWALS_LIMIT = 2**27
+    PENDING_CONSOLIDATIONS_LIMIT = 2**18
+    MAX_DEPOSIT_RECEIPTS_PER_PAYLOAD = 8192
+    MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD = 16
+    MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP = 8
+
     # --- Derived helpers --------------------------------------------------
 
     @classmethod

@@ -1,0 +1,114 @@
+"""BeaconProcessor work queues, batching, reprocessing, timer, executor."""
+
+import threading
+import time
+
+from lighthouse_tpu.beacon_processor import (
+    MAX_GOSSIP_ATTESTATION_BATCH_SIZE,
+    BeaconProcessor,
+    ReprocessQueue,
+    WorkEvent,
+    WorkType,
+)
+from lighthouse_tpu.utils.task_executor import ShutdownSignal, TaskExecutor
+
+
+def test_priority_and_batching():
+    proc = BeaconProcessor(num_workers=1)
+    seen = []
+    lock = threading.Lock()
+
+    def single(item):
+        with lock:
+            seen.append(("single", item))
+
+    def batch(items):
+        with lock:
+            seen.append(("batch", list(items)))
+
+    # 100 attestations coalesce into batches of <= 64
+    for i in range(100):
+        assert proc.submit(WorkType.GOSSIP_ATTESTATION, i, batch)
+    proc.submit(WorkType.GOSSIP_BLOCK, "blk", single)
+    assert proc.drain()
+    proc.shutdown()
+
+    batches = [x for kind, x in seen if kind == "batch"]
+    assert sum(len(b) for b in batches) == 100
+    assert all(len(b) <= MAX_GOSSIP_ATTESTATION_BATCH_SIZE for b in batches)
+    assert sorted(i for b in batches for i in b) == list(range(100))
+    assert ("single", "blk") in seen
+
+
+def test_queue_bound_backpressure():
+    proc = BeaconProcessor(num_workers=1)
+    blocker = threading.Event()
+
+    def handler(items):
+        blocker.wait(timeout=5)
+
+    # fill the chain-segment queue (bound 64) while the worker is busy
+    def slow(item):
+        blocker.wait(timeout=5)
+
+    accepted = sum(
+        proc.submit(WorkType.CHAIN_SEGMENT, i, slow) for i in range(200)
+    )
+    assert accepted <= 66  # bound + in-flight slop
+    blocker.set()
+    proc.drain()
+    proc.shutdown()
+
+
+def test_reprocess_queue_block_and_slot():
+    proc = BeaconProcessor(num_workers=1)
+    rq = ReprocessQueue()
+    seen = []
+
+    def h(item):
+        seen.append(item)
+
+    ev = WorkEvent(WorkType.UNKNOWN_BLOCK_ATTESTATION, "att1", h)
+    rq.hold_for_block(b"\x01" * 32, ev)
+    rq.hold_for_slot(10, WorkEvent(WorkType.API_REQUEST, "early", h))
+
+    assert rq.block_imported(b"\x01" * 32, proc) == 1
+    assert rq.slot_started(9, proc) == 0
+    assert rq.slot_started(10, proc) == 1
+    proc.drain()
+    proc.shutdown()
+    assert sorted(seen) == ["att1", "early"]
+
+
+def test_slot_timer_manual_tick():
+    from lighthouse_tpu.beacon_chain.timer import SlotTimer
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    clock = ManualSlotClock(genesis_time=0, seconds_per_slot=12)
+    fired = []
+    t = SlotTimer(clock, fired.append)
+    clock.set_slot(3)
+    assert t.tick()
+    assert not t.tick()  # same slot: no double fire
+    clock.set_slot(4)
+    assert t.tick()
+    assert fired == [3, 4]
+
+
+def test_task_executor_critical_failure_triggers_shutdown():
+    sig = ShutdownSignal()
+    ex = TaskExecutor(sig)
+
+    def boom():
+        raise RuntimeError("died")
+
+    ex.spawn(boom, "critical_service", critical=True)
+    assert sig.wait(timeout=5)
+    assert "critical_service" in sig.reason
+
+    # non-critical failure does not shut down
+    sig2 = ShutdownSignal()
+    ex2 = TaskExecutor(sig2)
+    ex2.spawn(boom, "optional_service", critical=False)
+    ex2.join_all()
+    assert not sig2.is_triggered()

@@ -1,0 +1,153 @@
+"""Incremental tree-hash cache vs full recompute.
+
+Mirrors the reference's cached_tree_hash tests (cache.rs:203-237): dirty
+single leaves, growth, shrink-triggered rebuild, and the state-level cache
+staying consistent with the from-scratch SSZ root across realistic
+mutations and copies."""
+
+import random
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.ssz.cached_tree_hash import (
+    BeaconStateHashCache,
+    TreeHashCache,
+    cached_state_root,
+)
+from lighthouse_tpu.ssz.merkle import merkleize
+
+
+def _rand_leaves(rng, n):
+    return np.frombuffer(
+        bytes(rng.randrange(256) for _ in range(n * 32)), dtype=np.uint8
+    ).reshape(n, 32).copy()
+
+
+def _full_root(leaves: np.ndarray, limit: int) -> bytes:
+    return merkleize(leaves.tobytes(), limit=limit)
+
+
+def test_tree_hash_cache_matches_merkleize():
+    rng = random.Random(0)
+    limit = 64
+    cache = TreeHashCache(limit)
+    leaves = _rand_leaves(rng, 10)
+    assert cache.update(leaves) == _full_root(leaves, limit)
+
+    # single dirty leaf
+    leaves[3] = _rand_leaves(rng, 1)[0]
+    assert cache.update(leaves) == _full_root(leaves, limit)
+
+    # growth within the pow2 block
+    leaves = np.vstack([leaves, _rand_leaves(rng, 5)])
+    assert cache.update(leaves) == _full_root(leaves, limit)
+
+    # growth crossing pow2 (rebuild path)
+    leaves = np.vstack([leaves, _rand_leaves(rng, 8)])
+    assert cache.update(leaves) == _full_root(leaves, limit)
+
+    # shrink (rebuild path)
+    leaves = leaves[:7]
+    assert cache.update(leaves) == _full_root(leaves, limit)
+
+    # no-op update
+    assert cache.update(leaves) == _full_root(leaves, limit)
+
+
+def test_tree_hash_cache_empty_and_full():
+    cache = TreeHashCache(16)
+    empty = np.zeros((0, 32), dtype=np.uint8)
+    assert cache.update(empty) == _full_root(empty, 16)
+    rng = random.Random(1)
+    full = _rand_leaves(rng, 16)
+    assert cache.update(full) == _full_root(full, 16)
+
+
+def test_cache_copy_is_independent():
+    rng = random.Random(2)
+    cache = TreeHashCache(32)
+    leaves = _rand_leaves(rng, 8)
+    cache.update(leaves)
+    dup = cache.copy()
+    mutated = leaves.copy()
+    mutated[0] = _rand_leaves(rng, 1)[0]
+    assert cache.update(mutated) == _full_root(mutated, 32)
+    assert dup.update(leaves) == _full_root(leaves, 32)  # unaffected
+
+
+# --- state-level ------------------------------------------------------------
+
+
+def _fresh_root(state) -> bytes:
+    """From-scratch root bypassing the instance override."""
+    return type(state).hash_tree_root_of(state)
+
+
+def test_cached_state_root_matches_full():
+    from dataclasses import replace
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.state_processing import interop_genesis_state
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    kps = bls.interop_keypairs(8)
+    state = interop_genesis_state(kps, 1_600_000_000, b"\x42" * 32, spec, E)
+
+    assert state.hash_tree_root() == _fresh_root(state)
+
+    # balance mutation
+    state.balances[0] += 12345
+    assert state.hash_tree_root() == _fresh_root(state)
+
+    # validator mutation (object-level memo invalidation)
+    state.validators[3].slashed = True
+    state.validators[3].withdrawable_epoch = 99
+    assert state.hash_tree_root() == _fresh_root(state)
+
+    # participation + inactivity churn
+    state.current_epoch_participation[2] = 7
+    state.inactivity_scores[5] = 42
+    assert state.hash_tree_root() == _fresh_root(state)
+
+    # slot-vector rotation
+    state.block_roots[1] = b"\x11" * 32
+    state.randao_mixes[0] = b"\x22" * 32
+    assert state.hash_tree_root() == _fresh_root(state)
+
+    # registry growth
+    v = state.validators[0].copy()
+    v.pubkey = b"\x05" * 48
+    state.validators.append(v)
+    state.balances.append(31_000_000_000)
+    state.previous_epoch_participation.append(0)
+    state.current_epoch_participation.append(0)
+    state.inactivity_scores.append(0)
+    assert state.hash_tree_root() == _fresh_root(state)
+
+    # copies stay consistent and independent
+    dup = state.copy()
+    dup.balances[1] += 1
+    assert dup.hash_tree_root() == _fresh_root(dup)
+    assert state.hash_tree_root() == _fresh_root(state)
+
+
+def test_cached_root_through_state_transition():
+    """The cache must survive per-slot/per-epoch processing (the paths that
+    mutate every big field)."""
+    from dataclasses import replace
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, E, validator_count=8)
+    h.extend_chain(E.SLOTS_PER_EPOCH + 3)
+    st = h.chain.head_state
+    assert st.hash_tree_root() == _fresh_root(st)

@@ -1,0 +1,219 @@
+"""Electra: EIP-7251 (maxeb), EIP-7002 (EL withdrawals), EIP-6110
+(deposit receipts).
+
+Parity targets: upgrade/electra.rs, beacon_state.rs:2118-2240 churn
+helpers, the electra container set, and the electra spec's block/epoch
+additions (pending deposit/consolidation queues, compounding-aware
+withdrawals)."""
+
+import hashlib
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_processing import interop_genesis_state, per_slot_processing
+from lighthouse_tpu.state_processing import electra as EL
+from lighthouse_tpu.types.chain_spec import FAR_FUTURE_EPOCH, ForkName, minimal_spec
+from lighthouse_tpu.types.containers import build_types
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+T = build_types(E)
+
+
+def electra_spec(**kw):
+    base = dict(
+        altair_fork_epoch=0,
+        bellatrix_fork_epoch=0,
+        capella_fork_epoch=0,
+        deneb_fork_epoch=0,
+        electra_fork_epoch=0,
+    )
+    base.update(kw)
+    return replace(minimal_spec(), **base)
+
+
+def _genesis(spec, n=16):
+    bls.set_backend("fake_crypto")
+    kps = bls.interop_keypairs(n)
+    return interop_genesis_state(kps, 1_600_000_000, b"\x42" * 32, spec, E)
+
+
+def test_genesis_at_electra_starts_in_electra():
+    st = _genesis(electra_spec())
+    assert type(st).__name__ == "BeaconStateElectra"
+    assert st.deposit_receipts_start_index == 2**64 - 1
+    assert st.earliest_exit_epoch >= 1
+    assert st.pending_balance_deposits == []
+    assert st.fork.current_version == electra_spec().electra_fork_version
+
+
+def test_upgrade_from_deneb_queues_compounding_excess():
+    spec = electra_spec(electra_fork_epoch=1)
+    st = _genesis(spec)
+    assert type(st).__name__ == "BeaconStateDeneb"
+    # make validator 0 a compounding early adopter with excess balance
+    st.validators[0].withdrawal_credentials = b"\x02" + b"\x00" * 11 + b"\xaa" * 20
+    st.balances[0] = 40_000_000_000
+    while st.slot < E.SLOTS_PER_EPOCH:
+        per_slot_processing(st, spec, E)
+    assert type(st).__name__ == "BeaconStateElectra"
+    # excess over MIN_ACTIVATION_BALANCE queued, balance clamped
+    assert st.balances[0] == spec.min_activation_balance
+    assert any(
+        d.index == 0 and d.amount == 8_000_000_000
+        for d in st.pending_balance_deposits
+    )
+
+
+def test_electra_state_ssz_roundtrip():
+    st = _genesis(electra_spec())
+    st.pending_balance_deposits.append(T.PendingBalanceDeposit(index=1, amount=5))
+    st.pending_partial_withdrawals.append(
+        T.PendingPartialWithdrawal(index=2, amount=7, withdrawable_epoch=9)
+    )
+    st.pending_consolidations.append(
+        T.PendingConsolidation(source_index=1, target_index=2)
+    )
+    data = st.serialize()
+    back = type(st).deserialize(data)
+    assert back.hash_tree_root() == st.hash_tree_root()
+    assert back.pending_partial_withdrawals[0].withdrawable_epoch == 9
+
+
+def test_deposit_receipt_flows_through_pending_queue():
+    spec = electra_spec()
+    st = _genesis(spec)
+    n0 = len(st.validators)
+    kp = bls.interop_keypairs(n0 + 1)[-1]
+    from lighthouse_tpu.state_processing.genesis import build_deposit_data
+
+    data = build_deposit_data(kp, 32_000_000_000, spec, E)
+    receipt = T.DepositReceipt(
+        pubkey=data.pubkey,
+        withdrawal_credentials=data.withdrawal_credentials,
+        amount=data.amount,
+        signature=data.signature,
+        index=77,
+    )
+    EL.process_deposit_receipt(st, receipt, spec, E)
+    assert st.deposit_receipts_start_index == 77
+    assert len(st.validators) == n0 + 1
+    v = st.validators[-1]
+    assert v.effective_balance == 0 and st.balances[-1] == 0
+    assert st.pending_balance_deposits[-1].amount == 32_000_000_000
+
+    # epoch processing applies the pending deposit (churn permitting)
+    EL.process_pending_balance_deposits(st, spec, E)
+    assert st.balances[-1] == 32_000_000_000
+    assert st.pending_balance_deposits == []
+
+
+def test_el_withdrawal_request_full_exit():
+    spec = electra_spec()
+    st = _genesis(spec)
+    addr = b"\xaa" * 20
+    v = st.validators[3]
+    v.withdrawal_credentials = b"\x01" + b"\x00" * 11 + addr
+    # age the validator past shard_committee_period
+    st.slot = (spec.shard_committee_period + 2) * E.SLOTS_PER_EPOCH
+    req = T.ExecutionLayerWithdrawalRequest(
+        source_address=addr,
+        validator_pubkey=v.pubkey,
+        amount=spec.full_exit_request_amount,
+    )
+    EL.process_execution_layer_withdrawal_request(st, req, spec, E)
+    assert st.validators[3].exit_epoch != FAR_FUTURE_EPOCH
+
+    # wrong source address is ignored
+    v5 = st.validators[5]
+    v5.withdrawal_credentials = b"\x01" + b"\x00" * 11 + addr
+    bad = T.ExecutionLayerWithdrawalRequest(
+        source_address=b"\xbb" * 20,
+        validator_pubkey=v5.pubkey,
+        amount=spec.full_exit_request_amount,
+    )
+    EL.process_execution_layer_withdrawal_request(st, bad, spec, E)
+    assert st.validators[5].exit_epoch == FAR_FUTURE_EPOCH
+
+
+def test_el_withdrawal_request_partial_compounding():
+    spec = electra_spec()
+    st = _genesis(spec)
+    addr = b"\xcc" * 20
+    v = st.validators[2]
+    v.withdrawal_credentials = b"\x02" + b"\x00" * 11 + addr
+    v.effective_balance = spec.min_activation_balance
+    st.balances[2] = spec.min_activation_balance + 3_000_000_000
+    st.slot = (spec.shard_committee_period + 2) * E.SLOTS_PER_EPOCH
+    req = T.ExecutionLayerWithdrawalRequest(
+        source_address=addr, validator_pubkey=v.pubkey, amount=2_000_000_000
+    )
+    EL.process_execution_layer_withdrawal_request(st, req, spec, E)
+    assert len(st.pending_partial_withdrawals) == 1
+    w = st.pending_partial_withdrawals[0]
+    assert w.index == 2 and w.amount == 2_000_000_000
+    assert st.validators[2].exit_epoch == FAR_FUTURE_EPOCH  # not an exit
+
+    # matured partials lead get_expected_withdrawals
+    st_m = st.copy()
+    st_m.slot = (w.withdrawable_epoch + 1) * E.SLOTS_PER_EPOCH
+    withdrawals, partials = EL.get_expected_withdrawals_electra(st_m, spec, E)
+    assert partials == 1
+    assert withdrawals[0].validator_index == 2
+    assert withdrawals[0].amount == 2_000_000_000
+
+
+def test_pending_consolidations_transfer_balance():
+    spec = electra_spec()
+    st = _genesis(spec)
+    st.pending_consolidations.append(
+        T.PendingConsolidation(source_index=1, target_index=2)
+    )
+    # source must be withdrawable for the transfer to fire
+    st.validators[1].withdrawable_epoch = 0
+    b1, b2 = st.balances[1], st.balances[2]
+    EL.process_pending_consolidations(st, spec, E)
+    moved = min(b1, spec.min_activation_balance)
+    assert st.balances[1] == b1 - moved
+    assert st.balances[2] == b2 + moved
+    assert st.pending_consolidations == []
+
+
+def test_effective_balance_updates_compounding_cap():
+    spec = electra_spec()
+    st = _genesis(spec)
+    st.validators[0].withdrawal_credentials = b"\x02" + b"\x00" * 31
+    st.balances[0] = 100_000_000_000  # 100 ETH
+    EL.process_effective_balance_updates_electra(st, spec, E)
+    assert st.validators[0].effective_balance == 100_000_000_000  # no 32 cap
+    # non-compounding stays capped at MIN_ACTIVATION_BALANCE
+    st.balances[1] = 100_000_000_000
+    EL.process_effective_balance_updates_electra(st, spec, E)
+    assert st.validators[1].effective_balance == spec.min_activation_balance
+
+
+def test_chain_crosses_into_electra_and_finalizes():
+    """Cross-fork e2e with a real (mock) execution layer: the chain ends in
+    BeaconStateElectra with hash-linked electra payloads and finality
+    advancing (the VERDICT 'done' criterion for this component)."""
+    from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+
+    spec = replace(
+        minimal_spec(),
+        altair_fork_epoch=0,
+        bellatrix_fork_epoch=0,
+        capella_fork_epoch=1,
+        deneb_fork_epoch=2,
+        electra_fork_epoch=3,
+    )
+    h = BeaconChainHarness(
+        spec, E, validator_count=16, mock_execution_layer=True
+    )
+    h.extend_chain(6 * E.SLOTS_PER_EPOCH)
+    st = h.chain.head_state
+    assert type(st).__name__ == "BeaconStateElectra"
+    assert h.finalized_epoch >= 4
+    header = st.latest_execution_payload_header
+    assert header.block_hash != b"\x00" * 32
+    assert hasattr(header, "deposit_receipts_root")

@@ -1,0 +1,90 @@
+"""Metrics registry + structured logging (lighthouse_metrics / logging
+analogs) and their wiring into the import/epoch paths."""
+
+import logging
+
+from lighthouse_tpu.metrics import (
+    REGISTRY,
+    Registry,
+    inc_counter,
+    observe,
+    set_gauge,
+    start_timer,
+)
+
+
+def test_counter_gauge_histogram_roundtrip():
+    r = Registry()
+    c = r.counter("requests_total")
+    c.inc()
+    c.inc(2, route="blocks")
+    assert c.value() == 1
+    assert c.value(route="blocks") == 2
+
+    g = r.gauge("head_slot")
+    g.set(42)
+    assert g.value() == 42
+
+    h = r.histogram("import_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    assert h.count == 3
+    assert abs(h.sum - 5.55) < 1e-9
+
+    text = r.expose()
+    assert "# TYPE requests_total counter" in text
+    assert 'requests_total{route="blocks"} 2' in text
+    assert "head_slot 42" in text
+    assert 'import_seconds_bucket{le="+Inf"} 3' in text
+    assert "import_seconds_count 3" in text
+
+
+def test_timer_records_duration():
+    r = Registry()
+    h = r.histogram("op_seconds")
+    with h.start_timer():
+        pass
+    assert h.count == 1
+    assert h.sum >= 0
+
+
+def test_global_helpers():
+    inc_counter("test_global_counter", 3)
+    set_gauge("test_global_gauge", 7)
+    observe("test_global_hist", 0.2)
+    t = start_timer("test_global_hist")
+    t.stop()
+    assert REGISTRY.counter("test_global_counter").value() == 3
+    assert REGISTRY.histogram("test_global_hist").count == 2
+
+
+def test_structured_logging_counts_into_metrics():
+    from lighthouse_tpu.utils.logging import get_logger
+
+    log = get_logger("lighthouse_tpu.test")
+    before = REGISTRY.counter("log_records_total").value(level="info")
+    log.info("imported block", slot=5, root="0xabcd")
+    after = REGISTRY.counter("log_records_total").value(level="info")
+    assert after == before + 1
+
+
+def test_block_import_records_metrics():
+    from dataclasses import replace
+
+    from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    bls.set_backend("fake_crypto")
+    before_blocks = REGISTRY.counter("beacon_blocks_imported_total").value()
+    before_epochs = REGISTRY.histogram("epoch_transition_seconds").count
+    h = BeaconChainHarness(minimal_spec(), E, validator_count=8)
+    h.extend_chain(E.SLOTS_PER_EPOCH + 1)
+    assert (
+        REGISTRY.counter("beacon_blocks_imported_total").value()
+        == before_blocks + E.SLOTS_PER_EPOCH + 1
+    )
+    assert REGISTRY.histogram("epoch_transition_seconds").count > before_epochs
+    assert REGISTRY.histogram("beacon_block_import_seconds").count > 0
