@@ -145,10 +145,14 @@ class BeaconProcessor:
                     break
                 t, batch = self._queues.pop_next()
                 set_gauge("beacon_processor_queue_depth", len(self._queues))
+                if batch:
+                    # inflight marked BEFORE the queue lock drops so drain()
+                    # can never observe empty-queues + zero-inflight while a
+                    # popped batch is still in the manager's hands
+                    with self._done_cv:
+                        self._inflight += 1
             if not batch:
                 continue
-            with self._done_cv:
-                self._inflight += 1
             self._work.put((t, batch))
 
     def _worker_loop(self):
@@ -158,12 +162,20 @@ class BeaconProcessor:
                 return
             t, batch = got
             try:
-                handler = batch[0].handler
                 if t in _BATCHED:
-                    handler([ev.item for ev in batch])
+                    # events may carry different batch handlers (gossip vs
+                    # API paths); group so each handler gets its own items
+                    by_handler: dict[int, tuple] = {}
+                    for ev in batch:
+                        key = id(ev.handler)
+                        if key not in by_handler:
+                            by_handler[key] = (ev.handler, [])
+                        by_handler[key][1].append(ev.item)
+                    for handler, items in by_handler.values():
+                        handler(items)
                 else:
                     for ev in batch:
-                        (ev.handler or handler)(ev.item)
+                        ev.handler(ev.item)
                 inc_counter(
                     "beacon_processor_processed_total",
                     amount=len(batch),

@@ -98,14 +98,18 @@ class Histogram:
         return self._sum
 
     def expose(self) -> list[str]:
+        with self._lock:  # consistent snapshot vs concurrent observe()
+            counts = list(self._counts)
+            total = self._total
+            sum_ = self._sum
         out = [f"# TYPE {self.name} histogram"]
         cum = 0
-        for b, c in zip(self.buckets, self._counts):
+        for b, c in zip(self.buckets, counts):
             cum += c
             out.append(f'{self.name}_bucket{{le="{_fmt_num(b)}"}} {cum}')
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {self._total}')
-        out.append(f"{self.name}_sum {_fmt_num(self._sum)}")
-        out.append(f"{self.name}_count {self._total}")
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        out.append(f"{self.name}_sum {_fmt_num(sum_)}")
+        out.append(f"{self.name}_count {total}")
         return out
 
 

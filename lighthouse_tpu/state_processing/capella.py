@@ -83,7 +83,10 @@ def process_withdrawals(state, execution_payload, E, spec: ChainSpec | None = No
         # Electra: matured pending partials lead the sweep and are popped
         from .electra import get_expected_withdrawals_electra
 
-        assert spec is not None, "electra withdrawals need the chain spec"
+        if spec is None:
+            raise ValueError(
+                "process_withdrawals on an Electra state requires spec="
+            )
         expected, partial_count = get_expected_withdrawals_electra(state, spec, E)
     else:
         expected = get_expected_withdrawals(state, E)

@@ -1,0 +1,304 @@
+"""Local golden-vector generation for the ef-test runner.
+
+The image cannot download `consensus-spec-tests` (zero egress), so this
+module manufactures a vector set in the same directory layout from the
+harness: valid cases record pre/operation/post, invalid cases record
+pre/operation with no post (the runner then requires a rejection). The
+goldens pin CURRENT behavior — regressions in any covered family make
+`run_all` fail — and the layout/codecs are identical to the official
+vectors, so a mounted real vector tree runs through the same handlers.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from dataclasses import replace
+
+import yaml
+
+from ..crypto import bls
+from ..state_processing import interop_genesis_state, per_slot_processing
+from ..state_processing.shuffle import shuffle_list
+from ..types.chain_spec import ForkName as _FN, minimal_spec
+from ..types.containers import build_types
+from ..types.eth_spec import MinimalEthSpec as E
+
+_GENESIS_TIME = 1_600_000_000
+
+
+def _write(case_dir: pathlib.Path, name: str, data):
+    case_dir.mkdir(parents=True, exist_ok=True)
+    if isinstance(data, (bytes, bytearray)):
+        (case_dir / f"{name}.ssz").write_bytes(bytes(data))
+    else:
+        with open(case_dir / f"{name}.yaml", "w") as f:
+            yaml.safe_dump(data, f)
+
+
+def _altair_harness(validators=16):
+    from .harness import StateHarness
+
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    return StateHarness(spec, E, validator_count=validators), spec
+
+
+def generate_goldens(root: str | pathlib.Path, seed: int = 7) -> int:
+    """Build the local vector tree under `root/tests/minimal/...`. Returns
+    the number of cases written."""
+    bls.set_backend("fake_crypto")
+    root = pathlib.Path(root)
+    base = root / "tests" / "minimal" / "altair"
+    t = build_types(E)
+    count = 0
+
+    h, spec = _altair_harness()
+    # advance into epoch 1 with real blocks so states carry participation
+    h.extend_chain(E.SLOTS_PER_EPOCH + 2)
+    state = h.state
+
+    # --- operations/attestation ------------------------------------------
+    atts = h.produce_attestations(state.copy(), state.slot, h.head_block_root())
+    att = atts[0]
+    pre = state.copy()
+    per_slot_processing(pre, spec, E)  # satisfy MIN_ATTESTATION_INCLUSION_DELAY
+    suite = base / "operations" / "attestation" / "pyspec_tests"
+    from ..state_processing.altair import process_attestation_altair
+    from ..state_processing.per_block import ConsensusContext
+    from ..types.chain_spec import ForkName
+
+    post = pre.copy()
+    process_attestation_altair(
+        post, att, spec, E, False, ConsensusContext(post.slot), ForkName.ALTAIR
+    )
+    _write(suite / "valid_0", "pre", pre.serialize())
+    _write(suite / "valid_0", "attestation", t.Attestation.serialize_value(att))
+    _write(suite / "valid_0", "post", post.serialize())
+    count += 1
+
+    bad = t.Attestation.deserialize(t.Attestation.serialize_value(att))
+    bad.data.target.epoch += 3  # future target: must be rejected
+    _write(suite / "invalid_target_0", "pre", pre.serialize())
+    _write(
+        suite / "invalid_target_0", "attestation", t.Attestation.serialize_value(bad)
+    )
+    count += 1
+
+    # --- sanity/slots -----------------------------------------------------
+    suite = base / "sanity" / "slots" / "pyspec_tests"
+    pre = state.copy()
+    post = pre.copy()
+    for _ in range(3):
+        per_slot_processing(post, spec, E)
+    _write(suite / "slots_3", "pre", pre.serialize())
+    _write(suite / "slots_3", "slots", 3)
+    _write(suite / "slots_3", "post", post.serialize())
+    count += 1
+
+    # --- sanity/blocks ----------------------------------------------------
+    suite = base / "sanity" / "blocks" / "pyspec_tests"
+    h2, spec2 = _altair_harness(8)
+    pre = h2.state.copy()
+    blocks = []
+    for _ in range(2):
+        produced = h2.produce_block(h2.state.slot + 1, [])
+        h2.process_block(produced.block)
+        blocks.append(produced.block)
+    case = suite / "two_blocks"
+    _write(case, "pre", pre.serialize())
+    for i, b in enumerate(blocks):
+        _write(case, f"blocks_{i}", b.serialize())
+    _write(case, "post", h2.state.serialize())
+    _write(case, "meta", {"blocks_count": len(blocks)})
+    count += 1
+
+    # --- epoch_processing -------------------------------------------------
+    from ..state_processing import altair as A
+    from ..state_processing import per_epoch as PE
+
+    epoch_subs = {
+        "justification_and_finalization": lambda st: (
+            A.process_justification_and_finalization_altair(st, E)
+        ),
+        "inactivity_updates": lambda st: A.process_inactivity_updates(st, spec, E),
+        "registry_updates": lambda st: PE.process_registry_updates(st, spec, E),
+        "effective_balance_updates": lambda st: (
+            PE.process_effective_balance_updates(st, E)
+        ),
+        "slashings": lambda st: A.process_slashings_altair(st, E, _FN.ALTAIR),
+    }
+    # a state at an epoch boundary with some balance skew
+    eb_state = state.copy()
+    while (eb_state.slot + 1) % E.SLOTS_PER_EPOCH != 0:
+        per_slot_processing(eb_state, spec, E)
+    eb_state.balances[0] = 20_000_000_000
+    eb_state.balances[1] = 33_000_000_000
+    for sub, fn in epoch_subs.items():
+        suite = base / "epoch_processing" / sub / "pyspec_tests"
+        pre = eb_state.copy()
+        post = pre.copy()
+        fn(post)
+        _write(suite / "case_0", "pre", pre.serialize())
+        _write(suite / "case_0", "post", post.serialize())
+        count += 1
+
+    # --- shuffling --------------------------------------------------------
+    suite = base / "shuffling" / "core" / "shuffle"
+    seed_bytes = bytes(range(32))
+    for n in (2, 7, 32):
+        mapping = shuffle_list(list(range(n)), seed_bytes, E.SHUFFLE_ROUND_COUNT)
+        _write(
+            suite / f"shuffle_{n}",
+            "mapping",
+            {
+                "seed": "0x" + seed_bytes.hex(),
+                "count": n,
+                "mapping": mapping,
+            },
+        )
+        count += 1
+
+    # --- ssz_static -------------------------------------------------------
+    import random as _r
+
+    rng = _r.Random(seed)
+    samples = {
+        "Checkpoint": t.Checkpoint(epoch=5, root=bytes(rng.randbytes(32))),
+        "Fork": t.Fork(
+            previous_version=b"\x00\x00\x00\x01",
+            current_version=b"\x01\x00\x00\x01",
+            epoch=9,
+        ),
+        "Validator": state.validators[0],
+        "AttestationData": att.data,
+        "BeaconBlockHeader": state.latest_block_header,
+        "SyncAggregate": t.SyncAggregate(
+            sync_committee_bits=[True, False] * (E.SYNC_COMMITTEE_SIZE // 2),
+            sync_committee_signature=bytes(96),
+        ),
+    }
+    for name, value in samples.items():
+        typ = getattr(t, name)
+        suite = base / "ssz_static" / name / "ssz_random"
+        _write(suite / "case_0", "serialized", typ.serialize_value(value))
+        _write(
+            suite / "case_0",
+            "roots",
+            {"root": "0x" + typ.hash_tree_root_of(value).hex()},
+        )
+        count += 1
+
+    # --- fork (altair upgrade) -------------------------------------------
+    suite = base / "fork" / "fork" / "pyspec_tests"
+    spec_pre = minimal_spec()
+    kps = bls.interop_keypairs(8)
+    phase0_state = interop_genesis_state(kps, _GENESIS_TIME, b"\x42" * 32, spec_pre, E)
+    spec_fork = replace(minimal_spec(), altair_fork_epoch=0)
+    from ..state_processing.upgrades import upgrade_to_altair
+
+    post = phase0_state.copy()
+    upgrade_to_altair(post, spec_fork, E)
+    case = suite / "fork_base"
+    _write(case, "pre", phase0_state.serialize())
+    _write(case, "post", post.serialize())
+    _write(case, "meta", {"fork": "altair"})
+    count += 1
+
+    # --- bls (real crypto; fork-agnostic: tests/general/phase0/bls) -------
+    bls.set_backend("host")
+    try:
+        bls_base = root / "tests" / "general" / "phase0" / "bls"
+        kps = bls.interop_keypairs(4)
+        msg = bytes(range(32))
+        sig = kps[0].sk.sign(msg)
+        _write(
+            bls_base / "verify" / "small" / "verify_valid",
+            "data",
+            {
+                "input": {
+                    "pubkey": "0x" + kps[0].pk.to_bytes().hex(),
+                    "message": "0x" + msg.hex(),
+                    "signature": "0x" + sig.to_bytes().hex(),
+                },
+                "output": True,
+            },
+        )
+        _write(
+            bls_base / "verify" / "small" / "verify_wrong_key",
+            "data",
+            {
+                "input": {
+                    "pubkey": "0x" + kps[1].pk.to_bytes().hex(),
+                    "message": "0x" + msg.hex(),
+                    "signature": "0x" + sig.to_bytes().hex(),
+                },
+                "output": False,
+            },
+        )
+        count += 2
+
+        sigs = [kp.sk.sign(msg) for kp in kps[:3]]
+        agg = bls.AggregateSignature.from_signatures(sigs).to_signature()
+        _write(
+            bls_base / "aggregate" / "small" / "aggregate_3",
+            "data",
+            {
+                "input": ["0x" + s.to_bytes().hex() for s in sigs],
+                "output": "0x" + agg.to_bytes().hex(),
+            },
+        )
+        _write(
+            bls_base / "fast_aggregate_verify" / "small" / "fav_valid",
+            "data",
+            {
+                "input": {
+                    "pubkeys": ["0x" + kp.pk.to_bytes().hex() for kp in kps[:3]],
+                    "message": "0x" + msg.hex(),
+                    "signature": "0x" + agg.to_bytes().hex(),
+                },
+                "output": True,
+            },
+        )
+        msgs = [bytes([i]) * 32 for i in range(3)]
+        persig = [kp.sk.sign(m) for kp, m in zip(kps[:3], msgs)]
+        _write(
+            bls_base / "batch_verify" / "small" / "batch_valid",
+            "data",
+            {
+                "input": {
+                    "pubkeys": ["0x" + kp.pk.to_bytes().hex() for kp in kps[:3]],
+                    "messages": ["0x" + m.hex() for m in msgs],
+                    "signatures": ["0x" + s.to_bytes().hex() for s in persig],
+                },
+                "output": True,
+            },
+        )
+        bad = list(persig)
+        bad[1] = persig[2]
+        _write(
+            bls_base / "batch_verify" / "small" / "batch_invalid",
+            "data",
+            {
+                "input": {
+                    "pubkeys": ["0x" + kp.pk.to_bytes().hex() for kp in kps[:3]],
+                    "messages": ["0x" + m.hex() for m in msgs],
+                    "signatures": ["0x" + s.to_bytes().hex() for s in bad],
+                },
+                "output": False,
+            },
+        )
+        _write(
+            bls_base / "sign" / "small" / "sign_case_0",
+            "data",
+            {
+                "input": {
+                    "privkey": "0x" + kps[0].sk.to_bytes().hex(),
+                    "message": "0x" + msg.hex(),
+                },
+                "output": "0x" + sig.to_bytes().hex(),
+            },
+        )
+        count += 4
+    finally:
+        bls.set_backend("fake_crypto")
+
+    return count

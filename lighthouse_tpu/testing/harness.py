@@ -74,7 +74,8 @@ class StateHarness:
             self.E,
         )
         root = compute_signing_root(block.hash_tree_root(), domain)
-        return t.SignedBeaconBlock(
+        tf = t.types_for_fork(t.fork_of_block(block))
+        return tf.SignedBeaconBlock(
             message=block, signature=self._sign(proposer_index, root)
         )
 
@@ -145,15 +146,24 @@ class StateHarness:
         state = self.state.copy()
         while state.slot < slot:
             per_slot_processing(state, self.spec, self.E)
+        # fork-aware container family (superstruct map_fork analog)
+        tf = t.types_for_fork(t.fork_of_state(state))
         proposer = get_beacon_proposer_index(state, self.E)
         parent_root = state.latest_block_header.hash_tree_root()
         # latest_block_header.state_root was filled by process_slot
-        body = t.BeaconBlockBody(
+        body_kwargs = dict(
             randao_reveal=self._randao_reveal(state, proposer, slot),
             eth1_data=state.eth1_data,
             attestations=attestations,
         )
-        block = t.BeaconBlock(
+        if hasattr(tf.BeaconBlockBody, "_fields") and "sync_aggregate" in (
+            tf.BeaconBlockBody._fields
+        ):
+            from ..beacon_chain.chain import empty_sync_aggregate
+
+            body_kwargs["sync_aggregate"] = empty_sync_aggregate(t, self.E)
+        body = tf.BeaconBlockBody(**body_kwargs)
+        block = tf.BeaconBlock(
             slot=slot,
             proposer_index=proposer,
             parent_root=parent_root,
@@ -164,7 +174,7 @@ class StateHarness:
         post = state.copy()
         ctxt = ConsensusContext(slot)
         ctxt.set_proposer_index(proposer)
-        signed_for_root = t.SignedBeaconBlock(message=block)
+        signed_for_root = tf.SignedBeaconBlock(message=block)
         per_block_processing(
             post,
             signed_for_root,

@@ -1,0 +1,346 @@
+"""Validator client: duties, attestation + block production, signing.
+
+Mirrors validator_client/src/lib.rs:91-98 — a `ValidatorStore` holding
+signing methods behind slashing protection, a `DutiesService` polling the
+beacon node for proposer/attester duties, per-slot `AttestationService`
+and `BlockService`, and doppelganger liveness gating. The beacon-node
+seam here is the in-process `BeaconChain` (the reference talks HTTP via
+common/eth2; the service logic is transport-agnostic and the HTTP client
+slots into `BeaconNodeInterface`)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..crypto import bls
+from ..metrics import inc_counter
+from ..state_processing.accessors import (
+    committee_cache_at,
+    compute_epoch_at_slot,
+    get_beacon_proposer_index,
+    get_domain,
+)
+from ..types.chain_spec import Domain, compute_signing_root
+from .slashing_protection import NotSafe, SlashingDatabase
+
+
+class SigningMethod:
+    """signing_method.rs:80-95 — LocalKeystore here; a Web3Signer client
+    implements the same `sign` seam."""
+
+    def sign(self, signing_root: bytes) -> bytes:
+        raise NotImplementedError
+
+
+class LocalKeystoreSigner(SigningMethod):
+    def __init__(self, secret_key: bls.SecretKey):
+        self.sk = secret_key
+
+    def sign(self, signing_root: bytes) -> bytes:
+        return self.sk.sign(signing_root).to_bytes()
+
+
+@dataclass
+class Duty:
+    validator_index: int
+    slot: int
+    committee_index: int
+    committee_position: int
+    committee_size: int
+
+
+class ValidatorStore:
+    """Keys + slashing protection (validator_store.rs analog)."""
+
+    def __init__(self, slashing_db: SlashingDatabase | None = None):
+        self.slashing_db = slashing_db or SlashingDatabase()
+        self._signers: dict[bytes, SigningMethod] = {}
+        self._indices: dict[bytes, int] = {}
+
+    def add_validator(self, pubkey: bytes, signer: SigningMethod):
+        self._signers[bytes(pubkey)] = signer
+        self.slashing_db.register_validator(pubkey)
+
+    def pubkeys(self):
+        return list(self._signers)
+
+    def signer_for(self, pubkey: bytes) -> SigningMethod | None:
+        return self._signers.get(bytes(pubkey))
+
+    def sign_block(self, pubkey: bytes, block, state, spec, E):
+        domain = get_domain(
+            state,
+            Domain.BEACON_PROPOSER,
+            compute_epoch_at_slot(block.slot, E),
+            spec,
+            E,
+        )
+        root = compute_signing_root(block.hash_tree_root(), domain)
+        self.slashing_db.check_and_insert_block_proposal(
+            pubkey, block.slot, root
+        )
+        return self._signers[bytes(pubkey)].sign(root)
+
+    def sign_attestation(self, pubkey: bytes, data, state, spec, E):
+        domain = get_domain(
+            state, Domain.BEACON_ATTESTER, data.target.epoch, spec, E
+        )
+        root = compute_signing_root(data.hash_tree_root(), domain)
+        self.slashing_db.check_and_insert_attestation(
+            pubkey, data.source.epoch, data.target.epoch, root
+        )
+        return self._signers[bytes(pubkey)].sign(root)
+
+    def sign_randao(self, pubkey: bytes, epoch: int, state, spec, E):
+        domain = get_domain(state, Domain.RANDAO, epoch, spec, E)
+        root = compute_signing_root(
+            epoch.to_bytes(8, "little").ljust(32, b"\x00"), domain
+        )
+        return self._signers[bytes(pubkey)].sign(root)
+
+
+class BeaconNodeInterface:
+    """What the services need from a BN (common/eth2 client surface)."""
+
+    def head_state(self):
+        raise NotImplementedError
+
+    def publish_block(self, signed_block):
+        raise NotImplementedError
+
+    def publish_attestations(self, attestations):
+        raise NotImplementedError
+
+    def produce_block(self, slot: int, randao_reveal: bytes):
+        raise NotImplementedError
+
+
+class LocalBeaconNode(BeaconNodeInterface):
+    """In-process BN (the HTTP client's stand-in for tests/sim)."""
+
+    def __init__(self, chain):
+        self.chain = chain
+
+    def head_state(self):
+        return self.chain.head_state
+
+    def head_root(self):
+        return self.chain.head_root
+
+    def publish_block(self, signed_block):
+        return self.chain.process_block(signed_block)
+
+    def publish_attestations(self, attestations):
+        return self.chain.process_attestation_batch(attestations)
+
+    def produce_block(self, slot: int, randao_reveal: bytes):
+        block, _post = self.chain.produce_block_on_state(slot, randao_reveal)
+        return block
+
+
+class DutiesService:
+    """Polls the BN state for this store's duties (duties_service.rs)."""
+
+    def __init__(self, store: ValidatorStore, node: BeaconNodeInterface, spec, E):
+        self.store = store
+        self.node = node
+        self.spec = spec
+        self.E = E
+
+    def _our_indices(self, state) -> dict[int, bytes]:
+        ours = {}
+        managed = set(self.store.pubkeys())
+        for i, v in enumerate(state.validators):
+            pk = bytes(v.pubkey)
+            if pk in managed:
+                ours[i] = pk
+        return ours
+
+    def attester_duties(self, epoch: int) -> list[Duty]:
+        from ..state_processing.accessors import compute_start_slot_at_epoch
+
+        state = self.node.head_state()
+        ours = self._our_indices(state)
+        cc = committee_cache_at(state, epoch, self.E)
+        start = compute_start_slot_at_epoch(epoch, self.E)
+        duties = []
+        for slot in range(start, start + self.E.SLOTS_PER_EPOCH):
+            for committee_index in range(cc.committees_per_slot):
+                committee = cc.committee(slot, committee_index)
+                for pos, vi in enumerate(committee):
+                    if vi in ours:
+                        duties.append(
+                            Duty(
+                                validator_index=vi,
+                                slot=slot,
+                                committee_index=committee_index,
+                                committee_position=pos,
+                                committee_size=len(committee),
+                            )
+                        )
+        return duties
+
+    def proposer_duty_at(self, slot: int):
+        """(validator_index, pubkey) when a managed key proposes at slot."""
+        from ..state_processing import per_slot_processing
+
+        state = self.node.head_state().copy()
+        while state.slot < slot:
+            per_slot_processing(state, self.spec, self.E)
+        proposer = get_beacon_proposer_index(state, self.E)
+        ours = self._our_indices(state)
+        if proposer in ours:
+            return proposer, ours[proposer], state
+        return None
+
+
+class AttestationService:
+    """Signs and publishes this store's attestations for a slot
+    (attestation_service.rs)."""
+
+    def __init__(self, duties: DutiesService, store: ValidatorStore, node, spec, E):
+        self.duties = duties
+        self.store = store
+        self.node = node
+        self.spec = spec
+        self.E = E
+
+    def attest(self, slot: int, head_root: bytes) -> list:
+        from ..state_processing import per_slot_processing
+        from ..state_processing.accessors import (
+            compute_start_slot_at_epoch,
+            get_block_root_at_slot,
+        )
+        from ..types.containers import build_types
+
+        t = build_types(self.E)
+        state = self.node.head_state().copy()
+        while state.slot < slot:
+            per_slot_processing(state, self.spec, self.E)
+        epoch = compute_epoch_at_slot(slot, self.E)
+        target_slot = compute_start_slot_at_epoch(epoch, self.E)
+        target_root = (
+            head_root
+            if target_slot >= slot
+            else get_block_root_at_slot(state, target_slot, self.E)
+        )
+        out = []
+        for duty in self.duties.attester_duties(epoch):
+            if duty.slot != slot:
+                continue
+            pk = None
+            v = state.validators[duty.validator_index]
+            pk = bytes(v.pubkey)
+            data = t.AttestationData(
+                slot=slot,
+                index=duty.committee_index,
+                beacon_block_root=head_root,
+                source=state.current_justified_checkpoint,
+                target=t.Checkpoint(epoch=epoch, root=target_root),
+            )
+            try:
+                sig = self.store.sign_attestation(pk, data, state, self.spec, self.E)
+            except NotSafe:
+                inc_counter("vc_slashing_protection_refusals_total")
+                continue
+            bits = [False] * duty.committee_size
+            bits[duty.committee_position] = True
+            out.append(
+                t.Attestation(
+                    aggregation_bits=bits, data=data, signature=sig
+                )
+            )
+        if out:
+            self.node.publish_attestations(out)
+            inc_counter("vc_attestations_published_total", amount=len(out))
+        return out
+
+
+class BlockService:
+    """Produces, signs, and publishes blocks for managed proposers
+    (block_service.rs)."""
+
+    def __init__(self, duties: DutiesService, store: ValidatorStore, node, spec, E):
+        self.duties = duties
+        self.store = store
+        self.node = node
+        self.spec = spec
+        self.E = E
+
+    def propose_if_due(self, slot: int):
+        duty = self.duties.proposer_duty_at(slot)
+        if duty is None:
+            return None
+        _proposer_index, pubkey, advanced_state = duty
+        epoch = compute_epoch_at_slot(slot, self.E)
+        randao = self.store.sign_randao(
+            pubkey, epoch, advanced_state, self.spec, self.E
+        )
+        block = self.node.produce_block(slot, randao)
+        try:
+            sig = self.store.sign_block(
+                pubkey, block, advanced_state, self.spec, self.E
+            )
+        except NotSafe:
+            inc_counter("vc_slashing_protection_refusals_total")
+            return None
+        from ..types.containers import build_types
+
+        t = build_types(self.E)
+        tf = t.types_for_fork(t.fork_of_block(block))
+        signed = tf.SignedBeaconBlock(message=block, signature=sig)
+        root = self.node.publish_block(signed)
+        inc_counter("vc_blocks_published_total")
+        return root
+
+
+class DoppelgangerService:
+    """Liveness gate: refuse signing for N epochs while watching for our
+    keys attesting elsewhere (doppelganger_service.rs, simplified to the
+    in-process observation surface)."""
+
+    def __init__(self, chain, store: ValidatorStore, epochs_to_check: int = 2):
+        self.chain = chain
+        self.store = store
+        self.epochs_to_check = epochs_to_check
+        self._start_epoch: int | None = None
+
+    def begin(self, current_epoch: int):
+        self._start_epoch = current_epoch
+
+    def signing_enabled(self, current_epoch: int) -> bool:
+        if self._start_epoch is None:
+            return True
+        return current_epoch >= self._start_epoch + self.epochs_to_check
+
+
+class ValidatorClient:
+    """ProductionValidatorClient analog: wires the services and drives them
+    per slot (lib.rs:91-98)."""
+
+    def __init__(self, chain, keypairs, spec, E, slashing_db=None):
+        self.chain = chain
+        self.spec = spec
+        self.E = E
+        self.node = LocalBeaconNode(chain)
+        self.store = ValidatorStore(slashing_db)
+        for kp in keypairs:
+            self.store.add_validator(kp.pk.to_bytes(), LocalKeystoreSigner(kp.sk))
+        self.duties_service = DutiesService(self.store, self.node, spec, E)
+        self.attestation_service = AttestationService(
+            self.duties_service, self.store, self.node, spec, E
+        )
+        self.block_service = BlockService(
+            self.duties_service, self.store, self.node, spec, E
+        )
+        self.doppelganger = DoppelgangerService(chain, self.store)
+
+    def on_slot(self, slot: int):
+        """One slot of VC work: propose (if due), then attest."""
+        epoch = compute_epoch_at_slot(slot, self.E)
+        if not self.doppelganger.signing_enabled(epoch):
+            return None
+        root = self.block_service.propose_if_due(slot)
+        head = self.chain.head_root
+        self.attestation_service.attest(slot, head)
+        return root

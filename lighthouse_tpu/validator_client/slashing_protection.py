@@ -1,0 +1,249 @@
+"""EIP-3076 slashing protection database.
+
+Mirrors validator_client/slashing_protection (src/lib.rs:14-25): a sqlite
+DB guarding every block proposal and attestation signature against double
+proposals, double votes, and surround votes, plus interchange-format
+(version 5) import/export. The same-data re-sign is permitted (idempotent
+signing), matching the reference's behavior."""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+
+SLASHING_PROTECTION_FILENAME = "slashing_protection.sqlite"
+INTERCHANGE_VERSION = "5"
+
+
+class NotSafe(Exception):
+    """Signing refused: would violate a slashing condition."""
+
+
+class SlashingDatabase:
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        c = self._conn
+        c.execute(
+            "CREATE TABLE IF NOT EXISTS validators ("
+            "id INTEGER PRIMARY KEY, pubkey BLOB UNIQUE NOT NULL)"
+        )
+        c.execute(
+            "CREATE TABLE IF NOT EXISTS signed_blocks ("
+            "validator_id INTEGER NOT NULL, slot INTEGER NOT NULL, "
+            "signing_root BLOB, UNIQUE (validator_id, slot))"
+        )
+        c.execute(
+            "CREATE TABLE IF NOT EXISTS signed_attestations ("
+            "validator_id INTEGER NOT NULL, source_epoch INTEGER NOT NULL, "
+            "target_epoch INTEGER NOT NULL, signing_root BLOB, "
+            "UNIQUE (validator_id, target_epoch))"
+        )
+        c.commit()
+
+    # -- registration ---------------------------------------------------------
+
+    def register_validator(self, pubkey: bytes) -> int:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO validators (pubkey) VALUES (?)",
+                (bytes(pubkey),),
+            )
+            self._conn.commit()
+        return self._validator_id(pubkey)
+
+    def _validator_id(self, pubkey: bytes) -> int:
+        row = self._conn.execute(
+            "SELECT id FROM validators WHERE pubkey = ?", (bytes(pubkey),)
+        ).fetchone()
+        if row is None:
+            raise NotSafe(f"unregistered validator {bytes(pubkey).hex()[:16]}")
+        return row[0]
+
+    # -- block proposals ------------------------------------------------------
+
+    def check_and_insert_block_proposal(
+        self, pubkey: bytes, slot: int, signing_root: bytes
+    ):
+        with self._lock:
+            vid = self._validator_id(pubkey)
+            row = self._conn.execute(
+                "SELECT signing_root FROM signed_blocks "
+                "WHERE validator_id = ? AND slot = ?",
+                (vid, slot),
+            ).fetchone()
+            if row is not None:
+                if row[0] == bytes(signing_root):
+                    return  # idempotent re-sign
+                raise NotSafe(f"double block proposal at slot {slot}")
+            row = self._conn.execute(
+                "SELECT MAX(slot) FROM signed_blocks WHERE validator_id = ?",
+                (vid,),
+            ).fetchone()
+            if row[0] is not None and slot <= row[0]:
+                raise NotSafe(
+                    f"block slot {slot} <= min safe slot {row[0] + 1}"
+                )
+            self._conn.execute(
+                "INSERT INTO signed_blocks VALUES (?, ?, ?)",
+                (vid, slot, bytes(signing_root)),
+            )
+            self._conn.commit()
+
+    # -- attestations ---------------------------------------------------------
+
+    def check_and_insert_attestation(
+        self, pubkey: bytes, source_epoch: int, target_epoch: int, signing_root: bytes
+    ):
+        if source_epoch > target_epoch:
+            raise NotSafe("attestation source > target")
+        with self._lock:
+            vid = self._validator_id(pubkey)
+            # double vote
+            row = self._conn.execute(
+                "SELECT signing_root FROM signed_attestations "
+                "WHERE validator_id = ? AND target_epoch = ?",
+                (vid, target_epoch),
+            ).fetchone()
+            if row is not None:
+                if row[0] == bytes(signing_root):
+                    return
+                raise NotSafe(f"double vote at target {target_epoch}")
+            # new surrounds an existing vote
+            row = self._conn.execute(
+                "SELECT source_epoch, target_epoch FROM signed_attestations "
+                "WHERE validator_id = ? AND source_epoch > ? AND target_epoch < ?",
+                (vid, source_epoch, target_epoch),
+            ).fetchone()
+            if row is not None:
+                raise NotSafe(f"surrounds existing vote {row}")
+            # existing vote surrounds the new one
+            row = self._conn.execute(
+                "SELECT source_epoch, target_epoch FROM signed_attestations "
+                "WHERE validator_id = ? AND source_epoch < ? AND target_epoch > ?",
+                (vid, source_epoch, target_epoch),
+            ).fetchone()
+            if row is not None:
+                raise NotSafe(f"surrounded by existing vote {row}")
+            # monotonic lower bounds (interchange minimality)
+            row = self._conn.execute(
+                "SELECT MAX(target_epoch) FROM signed_attestations "
+                "WHERE validator_id = ?",
+                (vid,),
+            ).fetchone()
+            if row[0] is not None and target_epoch <= row[0]:
+                raise NotSafe(
+                    f"target {target_epoch} <= min safe target {row[0] + 1}"
+                )
+            self._conn.execute(
+                "INSERT INTO signed_attestations VALUES (?, ?, ?, ?)",
+                (vid, source_epoch, target_epoch, bytes(signing_root)),
+            )
+            self._conn.commit()
+
+    # -- interchange (EIP-3076 JSON) ------------------------------------------
+
+    def export_interchange(self, genesis_validators_root: bytes) -> dict:
+        data = []
+        for vid, pubkey in self._conn.execute(
+            "SELECT id, pubkey FROM validators"
+        ).fetchall():
+            blocks = [
+                {
+                    "slot": str(slot),
+                    **(
+                        {"signing_root": "0x" + root.hex()}
+                        if root is not None
+                        else {}
+                    ),
+                }
+                for slot, root in self._conn.execute(
+                    "SELECT slot, signing_root FROM signed_blocks "
+                    "WHERE validator_id = ? ORDER BY slot",
+                    (vid,),
+                ).fetchall()
+            ]
+            atts = [
+                {
+                    "source_epoch": str(s),
+                    "target_epoch": str(t),
+                    **(
+                        {"signing_root": "0x" + root.hex()}
+                        if root is not None
+                        else {}
+                    ),
+                }
+                for s, t, root in self._conn.execute(
+                    "SELECT source_epoch, target_epoch, signing_root FROM "
+                    "signed_attestations WHERE validator_id = ? "
+                    "ORDER BY target_epoch",
+                    (vid,),
+                ).fetchall()
+            ]
+            data.append(
+                {
+                    "pubkey": "0x" + pubkey.hex(),
+                    "signed_blocks": blocks,
+                    "signed_attestations": atts,
+                }
+            )
+        return {
+            "metadata": {
+                "interchange_format_version": INTERCHANGE_VERSION,
+                "genesis_validators_root": "0x" + genesis_validators_root.hex(),
+            },
+            "data": data,
+        }
+
+    def import_interchange(self, doc: dict | str, genesis_validators_root: bytes):
+        if isinstance(doc, str):
+            doc = json.loads(doc)
+        meta = doc["metadata"]
+        if meta["interchange_format_version"] != INTERCHANGE_VERSION:
+            raise NotSafe(
+                f"interchange version {meta['interchange_format_version']} unsupported"
+            )
+        gvr = meta["genesis_validators_root"].removeprefix("0x")
+        if gvr != genesis_validators_root.hex():
+            raise NotSafe("interchange genesis_validators_root mismatch")
+        with self._lock:
+            for entry in doc["data"]:
+                pubkey = bytes.fromhex(entry["pubkey"].removeprefix("0x"))
+                self._conn.execute(
+                    "INSERT OR IGNORE INTO validators (pubkey) VALUES (?)",
+                    (pubkey,),
+                )
+                vid = self._conn.execute(
+                    "SELECT id FROM validators WHERE pubkey = ?", (pubkey,)
+                ).fetchone()[0]
+                for b in entry.get("signed_blocks", []):
+                    root = b.get("signing_root")
+                    self._conn.execute(
+                        "INSERT OR REPLACE INTO signed_blocks VALUES (?, ?, ?)",
+                        (
+                            vid,
+                            int(b["slot"]),
+                            bytes.fromhex(root.removeprefix("0x"))
+                            if root
+                            else None,
+                        ),
+                    )
+                for a in entry.get("signed_attestations", []):
+                    root = a.get("signing_root")
+                    self._conn.execute(
+                        "INSERT OR REPLACE INTO signed_attestations "
+                        "VALUES (?, ?, ?, ?)",
+                        (
+                            vid,
+                            int(a["source_epoch"]),
+                            int(a["target_epoch"]),
+                            bytes.fromhex(root.removeprefix("0x"))
+                            if root
+                            else None,
+                        ),
+                    )
+            self._conn.commit()
+
+    def close(self):
+        self._conn.close()

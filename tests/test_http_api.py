@@ -1,0 +1,134 @@
+"""HTTP Beacon API over a live harness chain (http_api test_utils analog):
+a real threaded server, exercised with urllib — node status, state/block
+queries, duties, SSZ block round-trip publishing, and /metrics."""
+
+import json
+import urllib.request
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.http_api import HttpApiServer
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+
+@pytest.fixture(scope="module")
+def rig():
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, E, validator_count=16)
+    h.extend_chain(E.SLOTS_PER_EPOCH + 2)
+    server = HttpApiServer(h.chain).start()
+    yield h, server
+    server.stop()
+
+
+def _get(server, path, accept=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{server.port}{path}")
+    if accept:
+        req.add_header("Accept", accept)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            data = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+            return resp.status, (json.loads(data) if "json" in ctype else data)
+    except urllib.error.HTTPError as e:
+        data = e.read()
+        try:
+            return e.code, json.loads(data)
+        except ValueError:
+            return e.code, data
+
+
+def test_node_endpoints(rig):
+    h, server = rig
+    status, _ = _get(server, "/eth/v1/node/health")
+    assert status == 200
+    _, version = _get(server, "/eth/v1/node/version")
+    assert "lighthouse-tpu" in version["data"]["version"]
+    _, syncing = _get(server, "/eth/v1/node/syncing")
+    assert syncing["data"]["head_slot"] == str(h.chain.head_state.slot)
+
+
+def test_genesis_and_state_endpoints(rig):
+    h, server = rig
+    _, genesis = _get(server, "/eth/v1/beacon/genesis")
+    assert genesis["data"]["genesis_validators_root"] == "0x" + (
+        h.chain.genesis_validators_root.hex()
+    )
+    _, root = _get(server, "/eth/v1/beacon/states/head/root")
+    assert root["data"]["root"] == "0x" + h.chain.head_state.hash_tree_root().hex()
+    _, fork = _get(server, "/eth/v1/beacon/states/head/fork")
+    assert fork["data"]["current_version"] == "0x" + (
+        h.chain.head_state.fork.current_version.hex()
+    )
+    _, fin = _get(server, "/eth/v1/beacon/states/head/finality_checkpoints")
+    assert int(fin["data"]["current_justified"]["epoch"]) >= 0
+    _, vals = _get(server, "/eth/v1/beacon/states/head/validators?id=0,2")
+    assert len(vals["data"]) == 2
+    assert vals["data"][0]["validator"]["pubkey"].startswith("0x")
+
+
+def test_block_endpoints_and_ssz(rig):
+    h, server = rig
+    _, header = _get(server, "/eth/v1/beacon/headers/head")
+    assert header["data"]["root"] == "0x" + h.chain.head_root.hex()
+    status, ssz = _get(
+        server, "/eth/v2/beacon/blocks/head", accept="application/octet-stream"
+    )
+    assert status == 200
+    assert ssz == h.chain.head_block().serialize()
+    _, root = _get(server, f"/eth/v1/beacon/blocks/{h.chain.head_state.slot}/root")
+    assert root["data"]["root"] == "0x" + h.chain.head_root.hex()
+    status, err = _get(server, "/eth/v1/beacon/headers/0x" + "00" * 32)
+    assert err["code"] == 404
+
+
+def test_proposer_duties(rig):
+    h, server = rig
+    epoch = h.chain.head_state.slot // E.SLOTS_PER_EPOCH
+    _, duties = _get(server, f"/eth/v1/validator/duties/proposer/{epoch}")
+    assert len(duties["data"]) == E.SLOTS_PER_EPOCH
+    assert all(d["pubkey"].startswith("0x") for d in duties["data"])
+
+
+def test_publish_block_ssz_roundtrip(rig):
+    h, server = rig
+    slot = h.chain.head_state.slot + 1
+    h.slot_clock.set_slot(slot)
+    # produce+sign but publish via the API instead of process_block
+    state = h.chain.head_state
+    from lighthouse_tpu.state_processing import per_slot_processing
+    from lighthouse_tpu.state_processing.accessors import get_beacon_proposer_index
+
+    proposer_state = state.copy()
+    while proposer_state.slot < slot:
+        per_slot_processing(proposer_state, h.spec, E)
+    proposer = get_beacon_proposer_index(proposer_state, E)
+    parent_root = h.chain.head_root
+    block, _post = h.chain.produce_block_on_state(
+        slot,
+        h.randao_reveal(proposer, slot, proposer_state),
+        sync_aggregate_fn=lambda st: h.make_sync_aggregate(st, slot, parent_root),
+    )
+    signed = h.sign_block(block, proposer_state)
+    data = signed.serialize()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/eth/v1/beacon/blocks",
+        data=data,
+        headers={"Content-Type": "application/octet-stream"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 200
+    assert h.chain.head_state.slot == slot  # imported through the API
+
+
+def test_metrics_endpoint(rig):
+    _h, server = rig
+    status, body = _get(server, "/metrics")
+    assert status == 200
+    assert b"beacon_blocks_imported_total" in body

@@ -1,0 +1,171 @@
+"""EIP-2333 key derivation + EIP-2335 keystores + AES core.
+
+Known-answer tests: FIPS-197 for AES, the EIP-2333 spec test case, and the
+EIP-2335 spec scrypt/pbkdf2 vectors."""
+
+import json
+
+import pytest
+
+from lighthouse_tpu.crypto.aes import _encrypt_block, _expand_key, aes128_ctr
+from lighthouse_tpu.crypto.key_derivation import (
+    derive_child_sk,
+    derive_master_sk,
+    derive_sk_from_path,
+    validator_keypair_path,
+)
+from lighthouse_tpu.crypto.keystore import Keystore, KeystoreError
+
+
+def test_aes_fips197_vector():
+    key = bytes(range(16))
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    assert (
+        _encrypt_block(pt, _expand_key(key)).hex()
+        == "69c4e0d86a7b0430d8cdb78070b4c55a"
+    )
+
+
+def test_aes_ctr_roundtrip():
+    key = b"\x01" * 16
+    iv = b"\x02" * 16
+    data = b"hello keystore world, this is longer than one block"
+    ct = aes128_ctr(key, iv, data)
+    assert ct != data
+    assert aes128_ctr(key, iv, ct) == data
+
+
+def test_eip2333_test_case_0():
+    seed = bytes.fromhex(
+        "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e53495531f"
+        "09a6987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04"
+    )
+    master = derive_master_sk(seed)
+    assert master == int(
+        "6083874454709270928345386274498605044986640685124978867557563392430687146096"
+    )
+    child = derive_child_sk(master, 0)
+    assert child == int(
+        "20397789859736650942317412262472558107875392172444076792671091975210932703118"
+    )
+
+
+def test_eip2334_path_derivation():
+    seed = b"\x42" * 32
+    direct = derive_child_sk(
+        derive_child_sk(
+            derive_child_sk(
+                derive_child_sk(derive_master_sk(seed), 12381), 3600
+            ),
+            5,
+        ),
+        0,
+    )
+    via_path = derive_sk_from_path(seed, "m/12381/3600/5/0")
+    assert direct == via_path
+    assert validator_keypair_path(5) == "m/12381/3600/5/0/0"
+    with pytest.raises(ValueError):
+        derive_sk_from_path(seed, "x/12381")
+
+
+# EIP-2335 spec test vectors (scrypt + pbkdf2): password, secret, and full
+# keystore JSON from the EIP.
+_EIP2335_PASSWORD = "\U0001D531\U0001D522\U0001D530\U0001D531\U0001D52D\U0001D51E\U0001D530\U0001D530\U0001D534\U0001D52C\U0001D52F\U0001D521\U0001F511"
+_EIP2335_SECRET = bytes.fromhex(
+    "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f"
+)
+
+_SCRYPT_VECTOR = {
+    "crypto": {
+        "kdf": {
+            "function": "scrypt",
+            "params": {
+                "dklen": 32,
+                "n": 262144,
+                "p": 1,
+                "r": 8,
+                "salt": "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e69aec8c0db1cb8fa3",
+            },
+            "message": "",
+        },
+        "checksum": {
+            "function": "sha256",
+            "params": {},
+            "message": "d2217fe5f3e9a1e34581ef8a78f7c9928e436d36dacc5e846690a5581e8ea484",
+        },
+        "cipher": {
+            "function": "aes-128-ctr",
+            "params": {"iv": "264daa3f303d7259501c93d997d84fe6"},
+            "message": "06ae90d55fe0a6e9c5c3bc5b170827b2e5cce3929ed3f116c2811e6366dfe20f",
+        },
+    },
+    "description": "This is a test keystore that uses scrypt to secure the secret.",
+    "pubkey": "9612d7a727c9d0a22e185a1c768478dfe919cada9266988cb32359c11f2b7b27f4ae4040902382ae2910c15e2b420d07",
+    "path": "m/12381/60/3141592653/589793238",
+    "uuid": "1d85ae20-35c5-4611-98e8-aa14a633906f",
+    "version": 4,
+}
+
+_PBKDF2_VECTOR = {
+    "crypto": {
+        "kdf": {
+            "function": "pbkdf2",
+            "params": {
+                "dklen": 32,
+                "c": 262144,
+                "prf": "hmac-sha256",
+                "salt": "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e69aec8c0db1cb8fa3",
+            },
+            "message": "",
+        },
+        "checksum": {
+            "function": "sha256",
+            "params": {},
+            "message": "8a9f5d9912ed7e75ea794bc5a89bca5f193721d30868ade6f73043c6ea6febf1",
+        },
+        "cipher": {
+            "function": "aes-128-ctr",
+            "params": {"iv": "264daa3f303d7259501c93d997d84fe6"},
+            "message": "cee03fde2af33149775b7223e7845e4fb2c8ae1792e5f99fe9ecf474cc8c16ad",
+        },
+    },
+    "description": "This is a test keystore that uses PBKDF2 to secure the secret.",
+    "pubkey": "9612d7a727c9d0a22e185a1c768478dfe919cada9266988cb32359c11f2b7b27f4ae4040902382ae2910c15e2b420d07",
+    "path": "m/12381/60/0/0",
+    "uuid": "64625def-3331-4eea-ab6f-782f3ed16a83",
+    "version": 4,
+}
+
+
+@pytest.mark.slow
+def test_eip2335_scrypt_vector():
+    ks = Keystore.from_json(json.dumps(_SCRYPT_VECTOR))
+    assert ks.decrypt(_EIP2335_PASSWORD) == _EIP2335_SECRET
+    with pytest.raises(KeystoreError):
+        ks.decrypt("wrong password")
+
+
+@pytest.mark.slow
+def test_eip2335_pbkdf2_vector():
+    ks = Keystore.from_json(json.dumps(_PBKDF2_VECTOR))
+    assert ks.decrypt(_EIP2335_PASSWORD) == _EIP2335_SECRET
+
+
+def test_keystore_roundtrip(tmp_path):
+    from lighthouse_tpu.crypto import bls
+
+    bls.set_backend("host")
+    secret = (12345).to_bytes(32, "big")
+    ks = Keystore.encrypt(
+        secret, "hunter2", path="m/12381/3600/0/0/0", _fast_kdf=True
+    )
+    p = tmp_path / "ks.json"
+    ks.save(p)
+    loaded = Keystore.load(p)
+    assert loaded.decrypt("hunter2") == secret
+    assert loaded.pubkey == bls.SecretKey.from_bytes(secret).public_key().to_bytes()
+    with pytest.raises(KeystoreError):
+        loaded.decrypt("wrong")
+
+    ks2 = Keystore.encrypt(secret, "pw", kdf="pbkdf2", _fast_kdf=True)
+    assert ks2.decrypt("pw") == secret
