@@ -165,6 +165,59 @@ def bench_block_import(jax):
     }
 
 
+def bench_epoch_transition(jax):
+    """Altair epoch sweep at 100k validators (single_pass.rs scale test):
+    vectorized flag/balance/registry passes over flat arrays."""
+    import random as _r
+    from dataclasses import replace
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.state_processing import interop_genesis_state
+    from lighthouse_tpu.state_processing.per_epoch import process_epoch
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec
+
+    E = MinimalEthSpec
+    bls.set_backend("fake_crypto")
+    n = 100_000
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    base = interop_genesis_state(
+        bls.interop_keypairs(8), 1_600_000_000, b"\x42" * 32, spec, E
+    )
+    # clone validator 0 out to n (deposit-path construction of 100k keys is
+    # minutes of BLS; registry shape is what the sweep cares about)
+    rng = _r.Random(3)
+    v0 = base.validators[0]
+    vs, bal, prev, cur, scores = [], [], bytearray(n), bytearray(n), []
+    for i in range(n):
+        v = v0.copy()
+        v.withdrawal_credentials = i.to_bytes(32, "little")
+        vs.append(v)
+        bal.append(31_000_000_000 + rng.randrange(2_000_000_000))
+        prev[i] = rng.randrange(8)
+        cur[i] = rng.randrange(8)
+        scores.append(rng.randrange(4))
+    base.validators = vs
+    base.balances = bal
+    base.previous_epoch_participation = prev
+    base.current_epoch_participation = cur
+    base.inactivity_scores = scores
+    base.slot = 3 * E.SLOTS_PER_EPOCH - 1
+
+    copies = [base.copy() for _ in range(3)]
+
+    def run():
+        process_epoch(copies.pop(), spec, E)  # copy cost excluded
+
+    t = _trials(run, n=3)
+    return {
+        "metric": "epoch_transition_100k",
+        "value": round(t["median_s"] * 1000, 1),
+        "unit": "ms/epoch (100k validators, minimal preset)",
+        "spread": t,
+    }
+
+
 def main():
     import jax
 
@@ -173,6 +226,7 @@ def main():
     for name, fn in (
         ("merkle", bench_merkle),
         ("block_import", bench_block_import),
+        ("epoch_transition", bench_epoch_transition),
     ):
         try:
             details.append(fn(jax))

@@ -1,0 +1,311 @@
+"""The umbrella CLI.
+
+Mirrors the `lighthouse` binary (lighthouse/src/main.rs:72,433-476):
+subcommands for the beacon node, the validator client, the database
+manager (database_manager/src/lib.rs), account tooling, and the lcli-style
+dev utilities (lcli/src/main.rs:624-657 — pretty-ssz, state-root,
+block-root, skip-slots, transition-blocks). Spec selection mainnet /
+minimal / gnosis via --spec (main.rs:445-449).
+
+Entry point: `python -m lighthouse_tpu <subcommand> …`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load_spec(name: str):
+    from .types.chain_spec import mainnet_spec, minimal_spec
+    from .types.eth_spec import GnosisEthSpec, MainnetEthSpec, MinimalEthSpec
+
+    specs = {
+        "mainnet": (mainnet_spec, MainnetEthSpec),
+        "minimal": (minimal_spec, MinimalEthSpec),
+        "gnosis": (mainnet_spec, GnosisEthSpec),
+    }
+    spec_fn, E = specs[name]
+    return spec_fn(), E
+
+
+def _state_type_for(data: bytes, E):
+    from .types.containers import build_types
+
+    try:
+        return build_types(E).decode_by_fork("BeaconState", data)
+    except ValueError as e:
+        raise SystemExit(f"error: {e}")
+
+
+def _block_type_for(data: bytes, E):
+    from .types.containers import build_types
+
+    try:
+        return build_types(E).decode_by_fork("SignedBeaconBlock", data)
+    except ValueError as e:
+        raise SystemExit(f"error: {e}")
+
+
+# ---------------------------------------------------------------------------
+# Subcommands
+# ---------------------------------------------------------------------------
+
+
+def cmd_beacon_node(args):
+    """Run an in-process dev beacon node: interop genesis, mock EL, HTTP
+    API, per-slot timer, optional self-validating keypairs (the local
+    dev-chain loop; production networking lands with the p2p stack)."""
+    import time
+
+    from .beacon_chain.harness import BeaconChainHarness
+    from .beacon_chain.timer import SlotTimer
+    from .crypto import bls
+    from .http_api import HttpApiServer
+    from .utils.logging import get_logger
+    from .validator_client import ValidatorClient
+
+    log = get_logger("lighthouse_tpu.bn")
+    bls.set_backend("fake_crypto" if args.fake_crypto else "host")
+    spec, E = _load_spec(args.spec)
+    from dataclasses import replace
+
+    spec = replace(spec, altair_fork_epoch=0, seconds_per_slot=args.seconds_per_slot)
+    h = BeaconChainHarness(
+        spec, E, validator_count=args.validators, mock_execution_layer=True
+    )
+    vc = ValidatorClient(h.chain, h.keypairs, spec, E) if args.validate else None
+    server = HttpApiServer(h.chain, port=args.http_port).start()
+    log.info("beacon node up", http_port=server.port, validators=args.validators)
+
+    def on_slot(slot):
+        h.slot_clock.set_slot(slot)  # no-op for system clock; manual in tests
+        if vc is not None:
+            root = vc.on_slot(slot)
+            log.info(
+                "slot processed",
+                slot=slot,
+                head=h.chain.head_root.hex()[:12],
+                proposed=bool(root),
+                finalized_epoch=h.finalized_epoch,
+            )
+
+    timer = SlotTimer(h.slot_clock, on_slot)
+    deadline = time.time() + args.run_for if args.run_for else None
+    try:
+        while deadline is None or time.time() < deadline:
+            timer.tick()
+            time.sleep(min(1.0, spec.seconds_per_slot / 4))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+def cmd_pretty_ssz(args):
+    """lcli pretty-ssz: decode an SSZ file and dump JSON-ish fields."""
+    _spec, E = _load_spec(args.spec)
+    data = open(args.file, "rb").read()
+    if args.type == "state":
+        obj = _state_type_for(data, E)
+    elif args.type == "block":
+        obj = _block_type_for(data, E)
+    else:
+        raise SystemExit(f"unknown type {args.type}")
+
+    def render(v):
+        if isinstance(v, (bytes, bytearray)):
+            return "0x" + bytes(v).hex()
+        if isinstance(v, list):
+            return f"[{len(v)} items]"
+        if hasattr(v, "_fields"):
+            return {f: render(getattr(v, f)) for f in v._fields}
+        return v
+
+    print(json.dumps({f: render(getattr(obj, f)) for f in obj._fields}, indent=2))
+    return 0
+
+
+def cmd_state_root(args):
+    _spec, E = _load_spec(args.spec)
+    st = _state_type_for(open(args.file, "rb").read(), E)
+    print("0x" + st.hash_tree_root().hex())
+    return 0
+
+
+def cmd_block_root(args):
+    _spec, E = _load_spec(args.spec)
+    b = _block_type_for(open(args.file, "rb").read(), E)
+    print("0x" + b.message.hash_tree_root().hex())
+    return 0
+
+
+def cmd_skip_slots(args):
+    """lcli skip-slots: advance a state N slots and write it back."""
+    from .state_processing import per_slot_processing
+
+    spec, E = _load_spec(args.spec)
+    st = _state_type_for(open(args.file, "rb").read(), E)
+    for _ in range(args.slots):
+        per_slot_processing(st, spec, E)
+    out = args.output or args.file
+    with open(out, "wb") as f:
+        f.write(st.serialize())
+    print(f"state advanced to slot {st.slot} -> {out}")
+    return 0
+
+
+def cmd_transition_blocks(args):
+    """lcli transition-blocks: apply a block to a pre-state (the state
+    transition profiling driver)."""
+    import time
+
+    from .state_processing import (
+        BlockSignatureStrategy,
+        per_block_processing,
+        per_slot_processing,
+    )
+
+    spec, E = _load_spec(args.spec)
+    st = _state_type_for(open(args.pre_state, "rb").read(), E)
+    block = _block_type_for(open(args.block, "rb").read(), E)
+    t0 = time.perf_counter()
+    while st.slot < block.message.slot:
+        per_slot_processing(st, spec, E)
+    per_block_processing(
+        st,
+        block,
+        spec,
+        E,
+        strategy=BlockSignatureStrategy.NO_VERIFICATION
+        if args.no_signature_verification
+        else BlockSignatureStrategy.VERIFY_BULK,
+    )
+    dt = time.perf_counter() - t0
+    print(f"transition OK in {dt*1000:.1f} ms; post root 0x{st.hash_tree_root().hex()}")
+    if args.output:
+        with open(args.output, "wb") as f:
+            f.write(st.serialize())
+    return 0
+
+
+def cmd_db(args):
+    """database_manager: version / inspect / migrate."""
+    from .store.hot_cold import CURRENT_SCHEMA_VERSION, SCHEMA_VERSION_KEY
+    from .store.kv import DBColumn, SqliteStore
+
+    store = SqliteStore(args.path)
+    try:
+        if args.db_cmd == "version":
+            raw = store.get(DBColumn.BEACON_META, SCHEMA_VERSION_KEY)
+            found = int.from_bytes(raw, "little") if raw else None
+            print(
+                json.dumps(
+                    {
+                        "on_disk": found,
+                        "supported": CURRENT_SCHEMA_VERSION,
+                        "compatible": found == CURRENT_SCHEMA_VERSION,
+                    }
+                )
+            )
+        elif args.db_cmd == "inspect":
+            out = {}
+            for col in DBColumn:
+                keys = store.keys(col)
+                out[col.name.lower()] = len(keys)
+            print(json.dumps(out, indent=2))
+        elif args.db_cmd == "migrate":
+            raw = store.get(DBColumn.BEACON_META, SCHEMA_VERSION_KEY)
+            found = int.from_bytes(raw, "little") if raw else None
+            if found == CURRENT_SCHEMA_VERSION:
+                print("already at current schema")
+            else:
+                raise SystemExit(
+                    f"no migration path from v{found} — re-sync required"
+                )
+        return 0
+    finally:
+        store.close()
+
+
+def cmd_interop_keys(args):
+    """Print deterministic interop keypairs (eth2_interop_keypairs)."""
+    from .crypto import bls
+
+    bls.set_backend("host")
+    for i, kp in enumerate(bls.interop_keypairs(args.count)):
+        print(f"{i}: pk=0x{kp.pk.to_bytes().hex()}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="lighthouse-tpu", description=__doc__.splitlines()[0]
+    )
+    p.add_argument(
+        "--spec",
+        choices=["mainnet", "minimal", "gnosis"],
+        default="mainnet",
+        help="preset (main.rs:445-449)",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    bn = sub.add_parser("bn", help="run a dev beacon node")
+    bn.add_argument("--validators", type=int, default=16)
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--seconds-per-slot", type=int, default=12)
+    bn.add_argument("--validate", action="store_true", help="run an in-process VC")
+    bn.add_argument("--fake-crypto", action="store_true")
+    bn.add_argument("--run-for", type=float, default=None, help="seconds then exit")
+    bn.set_defaults(fn=cmd_beacon_node)
+
+    pretty = sub.add_parser("pretty-ssz", help="decode an SSZ file")
+    pretty.add_argument("type", choices=["state", "block"])
+    pretty.add_argument("file")
+    pretty.set_defaults(fn=cmd_pretty_ssz)
+
+    sr = sub.add_parser("state-root", help="hash_tree_root of a state file")
+    sr.add_argument("file")
+    sr.set_defaults(fn=cmd_state_root)
+
+    br = sub.add_parser("block-root", help="root of a signed-block file")
+    br.add_argument("file")
+    br.set_defaults(fn=cmd_block_root)
+
+    sk = sub.add_parser("skip-slots", help="advance a state N slots")
+    sk.add_argument("file")
+    sk.add_argument("slots", type=int)
+    sk.add_argument("--output")
+    sk.set_defaults(fn=cmd_skip_slots)
+
+    tb = sub.add_parser("transition-blocks", help="apply a block to a state")
+    tb.add_argument("pre_state")
+    tb.add_argument("block")
+    tb.add_argument("--output")
+    tb.add_argument("--no-signature-verification", action="store_true")
+    tb.set_defaults(fn=cmd_transition_blocks)
+
+    db = sub.add_parser("db", help="database manager")
+    db.add_argument("db_cmd", choices=["version", "inspect", "migrate"])
+    db.add_argument("path")
+    db.set_defaults(fn=cmd_db)
+
+    ik = sub.add_parser("interop-keys", help="deterministic test keypairs")
+    ik.add_argument("count", type=int)
+    ik.set_defaults(fn=cmd_interop_keys)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,10 @@ class BeaconApi:
     def __init__(self, chain, validator_client=None):
         self.chain = chain
         self.vc = validator_client
+        # genesis facts survive snapshot-cache pruning at finality
+        gstate = chain._states[chain.genesis_block_root]
+        self._genesis_time = int(gstate.genesis_time)
+        self._genesis_validators_root = bytes(gstate.genesis_validators_root)
 
     # -- state resolution ----------------------------------------------------
 
@@ -70,10 +74,12 @@ class BeaconApi:
         if state_id == "head":
             return chain.head_state
         if state_id == "genesis":
-            st = chain.store.get_state(
-                chain._states[chain.genesis_block_root].hash_tree_root()
-            ) if chain.genesis_block_root in chain._states else None
-            return st or chain._states.get(chain.genesis_block_root)
+            st = chain._states.get(chain.genesis_block_root)
+            if st is None:
+                raise ApiError(
+                    404, "genesis state pruned from the hot cache"
+                )
+            return st
         if state_id == "finalized":
             cp = chain.finalized_checkpoint
             st = chain._justified_state_provider(cp.root)
@@ -139,11 +145,10 @@ class BeaconApi:
     # -- beacon --------------------------------------------------------------
 
     def genesis(self):
-        st = self.chain._states[self.chain.genesis_block_root]
         return {
             "data": {
-                "genesis_time": str(st.genesis_time),
-                "genesis_validators_root": _hex(st.genesis_validators_root),
+                "genesis_time": str(self._genesis_time),
+                "genesis_validators_root": _hex(self._genesis_validators_root),
                 "genesis_fork_version": _hex(self.chain.spec.genesis_fork_version),
             }
         }
@@ -210,36 +215,13 @@ class BeaconApi:
         root, _ = self._block(block_id)
         return {"data": {"root": _hex(root)}}
 
-    def pool_attestations(self):
-        pool = self.chain.op_pool
-        out = []
-        for att in getattr(pool, "attestations", lambda: [])() if callable(
-            getattr(pool, "attestations", None)
-        ) else []:
-            out.append(att)
-        return {"data": out}
-
-    def publish_attestations(self, attestations) -> int:
-        results = self.chain.process_attestation_batch(attestations)
-        failures = [r for r in results if isinstance(r, Exception)]
-        inc_counter("http_api_attestations_received", amount=len(attestations))
-        return 200 if not failures else 202
-
     def publish_block_ssz(self, data: bytes) -> int:
-        # Resolve the fork by decoding (exact re-serialization disambiguates
-        # sibling layouts), THEN import exactly once so a genuine rejection
-        # surfaces as itself and never re-attempts under another fork.
-        t = self.chain.types
-        signed = None
-        for fork in reversed(list(t.forks)):
-            try:
-                cand = t.types_for_fork(fork).SignedBeaconBlock.deserialize(data)
-            except Exception:  # noqa: BLE001 — not this fork's layout
-                continue
-            if cand.serialize() == data:
-                signed = cand
-                break
-        if signed is None:
+        # Resolve the fork first (exact-roundtrip decode), THEN import
+        # exactly once so a genuine rejection surfaces as itself and never
+        # re-attempts under another fork.
+        try:
+            signed = self.chain.types.decode_by_fork("SignedBeaconBlock", data)
+        except ValueError:
             raise ApiError(400, "block SSZ does not decode under any known fork")
         try:
             self.chain.process_block(signed)

@@ -1,0 +1,80 @@
+"""p2p wire messages + topic/digest helpers.
+
+Mirrors lighthouse_network's RPC method types (src/rpc/methods.rs) and
+gossip topic naming (src/types topic modules): SSZ containers for
+Status/Ping/Metadata/BlocksByRange/BlocksByRoot, fork-digest computation,
+and the /eth2/<digest>/<name>/ssz_snappy topic strings."""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..ssz.core import Bytes4, Bytes32, Container, List, uint64
+from ..types.chain_spec import ChainSpec
+
+# plain SSZ containers (p2p-interface.md)
+
+
+class StatusMessage(Container):
+    fork_digest: Bytes4
+    finalized_root: Bytes32
+    finalized_epoch: uint64
+    head_root: Bytes32
+    head_slot: uint64
+
+
+class Ping(Container):
+    data: uint64
+
+
+class MetadataMessage(Container):
+    seq_number: uint64
+    attnets: uint64  # bitfield64 packed
+
+
+class GoodbyeReason(Container):
+    reason: uint64
+
+
+class BlocksByRangeRequest(Container):
+    start_slot: uint64
+    count: uint64
+    step: uint64
+
+
+class BlocksByRootRequest(Container):
+    roots: List[Bytes32, 1024]
+
+
+GOODBYE_CLIENT_SHUTDOWN = 1
+GOODBYE_IRRELEVANT_NETWORK = 2
+GOODBYE_FAULT = 3
+GOODBYE_BANNED = 250
+
+
+def compute_fork_digest(spec: ChainSpec, current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    """compute_fork_digest: first 4 bytes of the fork data root."""
+    return spec.compute_fork_data_root(current_version, genesis_validators_root)[:4]
+
+
+def gossip_topic(fork_digest: bytes, name: str) -> str:
+    return f"/eth2/{fork_digest.hex()}/{name}/ssz_snappy"
+
+
+def message_id(message_domain: bytes, uncompressed: bytes) -> bytes:
+    """Gossip message-id (p2p spec: SHA256(domain + data)[:20])."""
+    return hashlib.sha256(message_domain + uncompressed).digest()[:20]
+
+
+# RPC protocol ids (rpc/protocol.rs)
+PROTO_STATUS = "/eth2/beacon_chain/req/status/1/ssz_snappy"
+PROTO_GOODBYE = "/eth2/beacon_chain/req/goodbye/1/ssz_snappy"
+PROTO_PING = "/eth2/beacon_chain/req/ping/1/ssz_snappy"
+PROTO_METADATA = "/eth2/beacon_chain/req/metadata/2/ssz_snappy"
+PROTO_BLOCKS_BY_RANGE = "/eth2/beacon_chain/req/beacon_blocks_by_range/2/ssz_snappy"
+PROTO_BLOCKS_BY_ROOT = "/eth2/beacon_chain/req/beacon_blocks_by_root/2/ssz_snappy"
+PROTO_GOSSIP = "/lighthouse_tpu/gossip/1"  # persistent pub/sub stream
+
+TOPIC_BEACON_BLOCK = "beacon_block"
+TOPIC_BEACON_ATTESTATION = "beacon_attestation_0"
+TOPIC_AGGREGATE = "beacon_aggregate_and_proof"

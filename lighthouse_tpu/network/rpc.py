@@ -1,0 +1,273 @@
+"""Req/resp RPC over TCP with SSZ-snappy payloads.
+
+Mirrors lighthouse_network's rpc stack (src/rpc/{methods,protocol,codec}):
+each stream opens with a length-prefixed protocol id (the multistream
+negotiation, collapsed to its essential byte exchange), the request is one
+varint-length-prefixed ssz_snappy payload, and responses are chunks of
+`<result byte><varint len><ssz_snappy payload>` — result 0 = success,
+1 = invalid request, 2 = server error (p2p-interface.md resp encoding).
+Transport security (noise) and muxing (yamux) sit below this layer in the
+reference; here each stream is one TCP connection on the host network."""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+from ..metrics import inc_counter
+from ..utils.snappy import compress, decompress
+from . import messages as M
+
+RESP_SUCCESS = 0
+RESP_INVALID_REQUEST = 1
+RESP_SERVER_ERROR = 2
+
+MAX_PAYLOAD = 1 << 22  # 4 MiB cap (gossip_max_size class bound)
+MAX_REQUEST_BLOCKS = 1024
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+# -- framing ------------------------------------------------------------------
+
+
+def _write_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(sock) -> int:
+    out = 0
+    shift = 0
+    while True:
+        b = _read_exact(sock, 1)[0]
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out
+        shift += 7
+        if shift > 35:
+            raise RpcError("varint too long")
+
+
+def _read_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise RpcError("connection closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _send_block(sock, data: bytes):
+    """ssz_snappy payload: <varint uncompressed-len><compressed-len u32>
+    <snappy frames>. The spec relies on stream framing for the compressed
+    boundary; over raw TCP an explicit length prefix carries it."""
+    if len(data) > MAX_PAYLOAD:
+        raise RpcError("payload too large")
+    comp = compress(data)
+    sock.sendall(_write_varint(len(data)) + struct.pack("<I", len(comp)) + comp)
+
+
+def _recv_block(sock) -> bytes:
+    expected = _read_varint(sock)
+    if expected > MAX_PAYLOAD:
+        raise RpcError("payload too large")
+    comp_len = struct.unpack("<I", _read_exact(sock, 4))[0]
+    if comp_len > MAX_PAYLOAD * 2:
+        raise RpcError("compressed payload too large")
+    data = decompress(_read_exact(sock, comp_len))
+    if len(data) != expected:
+        raise RpcError("length prefix mismatch")
+    return data
+
+
+def _send_protocol(sock, proto: str):
+    raw = proto.encode()
+    sock.sendall(bytes([len(raw)]) + raw)
+
+
+def _recv_protocol(sock) -> str:
+    n = _read_exact(sock, 1)[0]
+    return _read_exact(sock, n).decode()
+
+
+# -- server --------------------------------------------------------------------
+
+
+class RpcServer:
+    """Serves the req/resp protocols for one beacon node; gossip streams
+    are handed off to the network service's subscriber loop."""
+
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        self.node = node  # NetworkService
+
+        rpc = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    proto = _recv_protocol(self.request)
+                    if proto == M.PROTO_GOSSIP:
+                        rpc.node._handle_gossip_stream(self.request)
+                        return
+                    rpc._handle_rpc(proto, self.request)
+                except (RpcError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="rpc_server"
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- request dispatch -------------------------------------------------------
+
+    def _handle_rpc(self, proto: str, sock):
+        inc_counter("rpc_requests_total", protocol=proto.split("/")[-3])
+        node = self.node
+        if proto == M.PROTO_STATUS:
+            _req = M.StatusMessage.deserialize(_recv_block(sock))
+            self._respond(sock, RESP_SUCCESS, node.local_status().serialize())
+        elif proto == M.PROTO_PING:
+            _req = M.Ping.deserialize(_recv_block(sock))
+            self._respond(
+                sock, RESP_SUCCESS, M.Ping(data=node.metadata_seq).serialize()
+            )
+        elif proto == M.PROTO_METADATA:
+            self._respond(
+                sock,
+                RESP_SUCCESS,
+                M.MetadataMessage(
+                    seq_number=node.metadata_seq, attnets=0
+                ).serialize(),
+            )
+        elif proto == M.PROTO_GOODBYE:
+            _req = M.GoodbyeReason.deserialize(_recv_block(sock))
+            self._respond(sock, RESP_SUCCESS, M.GoodbyeReason(reason=0).serialize())
+        elif proto == M.PROTO_BLOCKS_BY_RANGE:
+            req = M.BlocksByRangeRequest.deserialize(_recv_block(sock))
+            if req.count > MAX_REQUEST_BLOCKS or req.step != 1:
+                self._respond(sock, RESP_INVALID_REQUEST, b"")
+                return
+            for signed in node.blocks_by_range(req.start_slot, req.count):
+                self._respond(sock, RESP_SUCCESS, signed.serialize())
+            sock.shutdown(socket.SHUT_WR)
+        elif proto == M.PROTO_BLOCKS_BY_ROOT:
+            req = M.BlocksByRootRequest.deserialize(_recv_block(sock))
+            for signed in node.blocks_by_root(list(req.roots)):
+                self._respond(sock, RESP_SUCCESS, signed.serialize())
+            sock.shutdown(socket.SHUT_WR)
+        else:
+            self._respond(sock, RESP_INVALID_REQUEST, b"")
+
+    @staticmethod
+    def _respond(sock, result: int, payload: bytes):
+        sock.sendall(bytes([result]))
+        _send_block(sock, payload)
+
+
+# -- client --------------------------------------------------------------------
+
+
+class RpcClient:
+    """One-shot request streams to a peer (rpc/outbound.rs analog)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.addr = (host, port)
+        self.timeout = timeout
+
+    def _open(self, proto: str):
+        sock = socket.create_connection(self.addr, timeout=self.timeout)
+        _send_protocol(sock, proto)
+        return sock
+
+    def _request_one(self, proto: str, payload: bytes) -> bytes:
+        with self._open(proto) as sock:
+            _send_block(sock, payload)
+            result = _read_exact(sock, 1)[0]
+            data = _recv_block(sock)
+            if result != RESP_SUCCESS:
+                raise RpcError(f"{proto}: error response {result}: {data!r}")
+            return data
+
+    def status(self, local: M.StatusMessage) -> M.StatusMessage:
+        return M.StatusMessage.deserialize(
+            self._request_one(M.PROTO_STATUS, local.serialize())
+        )
+
+    def ping(self, seq: int) -> int:
+        resp = M.Ping.deserialize(
+            self._request_one(M.PROTO_PING, M.Ping(data=seq).serialize())
+        )
+        return int(resp.data)
+
+    def metadata(self) -> M.MetadataMessage:
+        with self._open(M.PROTO_METADATA) as sock:
+            # metadata has no request body
+            result = _read_exact(sock, 1)[0]
+            data = _recv_block(sock)
+            if result != RESP_SUCCESS:
+                raise RpcError("metadata error")
+            return M.MetadataMessage.deserialize(data)
+
+    def goodbye(self, reason: int):
+        try:
+            self._request_one(
+                M.PROTO_GOODBYE, M.GoodbyeReason(reason=reason).serialize()
+            )
+        except (RpcError, OSError):
+            pass
+
+    def _stream_blocks(self, proto: str, payload: bytes, decode_block):
+        out = []
+        with self._open(proto) as sock:
+            _send_block(sock, payload)
+            while True:
+                try:
+                    result_b = sock.recv(1)
+                except OSError:
+                    break
+                if not result_b:
+                    break
+                result = result_b[0]
+                data = _recv_block(sock)
+                if result != RESP_SUCCESS:
+                    raise RpcError(f"{proto}: chunk error {result}")
+                out.append(decode_block(data))
+        return out
+
+    def blocks_by_range(self, start_slot: int, count: int, decode_block):
+        req = M.BlocksByRangeRequest(start_slot=start_slot, count=count, step=1)
+        return self._stream_blocks(
+            M.PROTO_BLOCKS_BY_RANGE, req.serialize(), decode_block
+        )
+
+    def blocks_by_root(self, roots: list, decode_block):
+        req = M.BlocksByRootRequest(roots=roots)
+        return self._stream_blocks(
+            M.PROTO_BLOCKS_BY_ROOT, req.serialize(), decode_block
+        )

@@ -221,28 +221,79 @@ def miller_loop_batch(xp, yp, q_x, q_y):
     return f12_conj(f)  # x < 0
 
 
-def _pow_x(a):
-    """a^|x| (64 fixed iterations)."""
-    return f12_pow_bits(a, _X_BITS_LSB)
+# --- staged jit pieces ------------------------------------------------------
+# One mega-jit (miller + final exp + reductions) made XLA-CPU compile for
+# hours on slow hosts: each baked-in pow chain became its own while loop
+# with a huge body. Instead: the Miller scan is one jit; the final
+# exponentiation is orchestrated in Python over a SINGLE runtime-bits
+# f12-pow scan (compiled once, reused for all five x-powers) plus small
+# straight-line jits.
+
+_miller_jit = jax.jit(miller_loop_batch)
+
+_X_BITS_64 = np.array([(_ATE >> i) & 1 for i in range(64)], dtype=np.int32)
+
+
+@jax.jit
+def _jit_f12_pow_var(a, bits):
+    """a^e for runtime LSB-first bits — the shared f12 square-and-multiply."""
+    one = f12_ones(a.shape[:-4])
+
+    def body(carry, bit):
+        acc, base = carry
+        acc = jnp.where(bit > 0, f12_mul(acc, base), acc)
+        return (acc, f12_sqr(base)), None
+
+    (acc, _), _ = lax.scan(body, (one, a), bits)
+    return acc
+
+
+def _pow_x_conj(a):
+    """a^x = conj(a^|x|) (x < 0)."""
+    return _jit_f12_conj(_jit_f12_pow_var(a, jnp.asarray(_X_BITS_64)))
+
+
+_jit_f12_mul = jax.jit(f12_mul)
+_jit_f12_conj = jax.jit(f12_conj)
+_jit_f12_frob = jax.jit(f12_frob)
+_jit_f12_frob2 = jax.jit(f12_frob2)
+
+
+@jax.jit
+def _jit_f12_inv(a):
+    return f12_inv(a)
+
+
+@jax.jit
+def _jit_easy_part(F, Finv):
+    t = f12_mul(f12_conj(F), Finv)  # ^(p⁶−1)
+    return f12_mul(f12_frob2(t), t)  # ^(p²+1): now cyclotomic
+
+
+@jax.jit
+def _jit_t_cubed_mul(y4, t):
+    return f12_mul(y4, f12_mul(f12_sqr(t), t))
 
 
 def final_exp_cubed(F):
     """F^(3·(p¹²−1)/r) — easy part then the (x−1)²(x+p)(x²+p²−1)+3 chain.
     Cube of the host oracle's final_exponentiation; identical for ==1
-    checks."""
-    t = f12_mul(f12_conj(F), f12_inv(F))      # ^(p⁶−1)
-    t = f12_mul(f12_frob2(t), t)              # ^(p²+1): now cyclotomic
-    y1 = f12_conj(f12_mul(_pow_x(t), t))      # t^(x−1)
-    y2 = f12_conj(f12_mul(_pow_x(y1), y1))    # t^(x−1)²
-    y3 = f12_mul(f12_conj(_pow_x(y2)), f12_frob(y2))   # ^(x+p)
-    a = f12_conj(_pow_x(y3))                  # y3^x
-    b = f12_conj(_pow_x(a))                   # y3^(x²)
-    y4 = f12_mul(f12_mul(b, f12_frob2(y3)), f12_conj(y3))  # ^(x²+p²−1)
-    return f12_mul(y4, f12_mul(f12_sqr(t), t))             # · t³
+    checks. Python orchestration over staged jits."""
+    t = _jit_easy_part(F, _jit_f12_inv(F))
+    y1 = _jit_f12_conj(_jit_f12_mul(_jit_f12_pow_var(t, jnp.asarray(_X_BITS_64)), t))
+    y2 = _jit_f12_conj(_jit_f12_mul(_jit_f12_pow_var(y1, jnp.asarray(_X_BITS_64)), y1))
+    y3 = _jit_f12_mul(_pow_x_conj(y2), _jit_f12_frob(y2))  # ^(x+p)
+    a = _pow_x_conj(y3)  # y3^x
+    b = _pow_x_conj(a)  # y3^(x²)
+    y4 = _jit_f12_mul(_jit_f12_mul(b, _jit_f12_frob2(y3)), _jit_f12_conj(y3))
+    return _jit_t_cubed_mul(y4, t)
 
 
-def _reduce_mul(f):
-    """Tree-product over the leading batch axis → [1] Fq12 (pads with 1)."""
+@jax.jit
+def _jit_mask_and_reduce(f, p_inf, q_inf):
+    """Infinity lanes → identity, then tree-product to [1] Fq12."""
+    skip = p_inf | q_inf
+    f = f12_select(skip, f12_ones(f.shape[:-4]), f)
     n = f.shape[0]
     while n > 1:
         half = n // 2
@@ -254,22 +305,18 @@ def _reduce_mul(f):
     return f
 
 
-@jax.jit
 def multi_pairing_check_device(xp, yp, p_inf, q_x, q_y, q_inf):
     """∏ e(P_i, Q_i) == 1 over the batch, entirely on device. Infinity
     lanes contribute the identity (host oracle behavior)."""
-    f = miller_loop_batch(xp, yp, q_x, q_y)
-    skip = p_inf | q_inf
-    f = f12_select(skip, f12_ones(f.shape[:-4]), f)
-    F = _reduce_mul(f)
+    f = _miller_jit(xp, yp, q_x, q_y)
+    F = _jit_mask_and_reduce(f, p_inf, q_inf)
     return f12_is_one(final_exp_cubed(F))[0]
 
 
-@jax.jit
 def pairing_cubed_device(xp, yp, q_x, q_y):
     """e(P, Q)³ per lane (full final exp per element — for tests; batch
     verification never needs per-element GT values)."""
-    f = miller_loop_batch(xp, yp, q_x, q_y)
+    f = _miller_jit(xp, yp, q_x, q_y)
     return final_exp_cubed(f)
 
 

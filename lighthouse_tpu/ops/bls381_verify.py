@@ -1,0 +1,317 @@
+"""Fully-device BLS batch signature verification.
+
+TPU analog of blst's `verify_multiple_aggregate_signatures`
+(crypto/bls/src/impls/blst.rs:35-117) — the random-linear-combination batch
+check
+
+    e(-G1, Σ rᵢ·sigᵢ) · Π_m e(Σ_{i: msgᵢ=m} rᵢ·aggpkᵢ, H(m)) == 1
+
+with EVERY group/field operation on device:
+
+  1. per-set pubkey aggregation   — padded tree-reduction over the
+                                     committee axis (G1, Fq lanes)
+  2. G2 subgroup checks on sigs   — ψ-endomorphism ladder (bls381_pairing)
+  3. rᵢ scalar multiplications    — batched double-and-add ladders (bls381)
+  4. signature sum Σ rᵢ·sigᵢ     — G2 tree-reduction
+  5. H(m) hash-to-curve           — device SSWU (bls381_htc; host does only
+                                     the SHA-256 expand_message_xmd)
+  6. Jacobian→affine              — batched Fermat inversions
+  7. Miller loops + final exp     — one multi-pairing (bls381_pairing)
+
+The host's remaining jobs: point decompression (bytes → ints, cached on the
+PublicKey/Signature wrappers), RLC scalar sampling, and batch-shape
+bucketing (powers of two, so jit caches a handful of shapes — the reference
+batches gossip work in fixed chunks of 64 for the same reason,
+beacon_processor/src/lib.rs:200).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto.bls12_381.fields import P
+from .bls381 import (
+    NLIMB,
+    DevFq,
+    DevFq2,
+    fq_to_device,
+    g1_points_to_device,
+    batch_g1_scalar_mul,
+    batch_g2_scalar_mul,
+    mont_mul,
+    pt_add,
+    scalars_to_bits,
+)
+from .bls381_htc import (
+    f2_inv_staged,
+    fq_inv_staged,
+    hash_to_g2_device,
+    messages_to_field_device,
+)
+from .bls381_pairing import (
+    g1_affine_to_device,
+    g2_affine_to_device,
+    g2_subgroup_check_device,
+    multi_pairing_check_device,
+)
+
+# ---------------------------------------------------------------------------
+# Generic reductions / conversions
+# ---------------------------------------------------------------------------
+
+
+def _tree_reduce_axis1(F, pt):
+    """Tree-sum points along axis 1: coords [n, k, ...] → [n, ...]."""
+    k = pt[0].shape[1]
+    while k > 1:
+        half = k // 2
+        lo = tuple(c[:, :half] for c in pt)
+        hi = tuple(c[:, half : 2 * half] for c in pt)
+        merged = pt_add(F, lo, hi)
+        if k % 2:
+            pt = tuple(
+                jnp.concatenate([m, c[:, -1:]], axis=1) for m, c in zip(merged, pt)
+            )
+            k = half + 1
+        else:
+            pt = merged
+            k = half
+    return tuple(c[:, 0] for c in pt)
+
+
+@jax.jit
+def g1_segment_sum(xs, ys, zs):
+    """[n, k] padded G1 points (infinity pads) → [n] sums."""
+    return _tree_reduce_axis1(DevFq, (xs, ys, zs))
+
+
+@jax.jit
+def g2_sum_reduce(xs, ys, zs):
+    """Tree-reduce a batch of G2 points to a single sum ([n] → [1])."""
+    pt = (xs[None], ys[None], zs[None])  # [1, n, ...]
+    out = _tree_reduce_axis1(DevFq2, pt)
+    return tuple(c for c in out)
+
+
+@jax.jit
+def _jit_g1_affine_from_inv(x, y, z, zinv):
+    zinv2 = mont_mul(zinv, zinv)
+    ax = mont_mul(x, zinv2)
+    ay = mont_mul(y, mont_mul(zinv2, zinv))
+    inf = jnp.all(z == 0, axis=-1)
+    return ax, ay, inf
+
+
+def g1_jac_to_affine(x, y, z):
+    """Batched Jacobian→affine over Fq: returns (ax, ay, inf_mask)."""
+    return _jit_g1_affine_from_inv(x, y, z, fq_inv_staged(z))
+
+
+@jax.jit
+def _jit_g2_affine_from_inv(x, y, z, zinv):
+    from .bls381_tower import f2_mul, f2_sqr
+
+    zinv2 = f2_sqr(zinv)
+    ax = f2_mul(x, zinv2)
+    ay = f2_mul(y, f2_mul(zinv2, zinv))
+    inf = jnp.all(z == 0, axis=(-1, -2))
+    return ax, ay, inf
+
+
+def g2_jac_to_affine(x, y, z):
+    """Batched Jacobian→affine over Fq2 (coords [..., 2, 48])."""
+    return _jit_g2_affine_from_inv(x, y, z, f2_inv_staged(z))
+
+
+# ---------------------------------------------------------------------------
+# Host-side staging
+# ---------------------------------------------------------------------------
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def _affine_int(pt):
+    """Host Jacobian int point → affine (x, y) or None; z==1 fast path (all
+    decompressed points arrive affine)."""
+    if pt is None:
+        return None
+    x, y, z = pt
+    if isinstance(z, tuple):  # Fq2
+        if z == (0, 0):
+            return None
+        if z == (1, 0):
+            return (x, y)
+        from ..crypto.bls12_381 import FQ2, to_affine
+
+        return to_affine(FQ2, (x, y, z))
+    if z == 0:
+        return None
+    if z == 1:
+        return (x, y)
+    from ..crypto.bls12_381 import FQ, to_affine
+
+    return to_affine(FQ, (x, y, z))
+
+
+_G1_INF_LIMBS = np.zeros(NLIMB, dtype=np.int32)
+
+
+def _g1_affine_grid_to_device(grids):
+    """[n][k] host affine-or-None G1 → Jacobian device arrays [n, k, 48]×3
+    (infinity encoded z=0)."""
+    from .bls381 import R_MONT, int_to_limbs
+
+    n = len(grids)
+    k = len(grids[0])
+    xs = np.zeros((n, k, NLIMB), dtype=np.int32)
+    ys = np.zeros((n, k, NLIMB), dtype=np.int32)
+    zs = np.zeros((n, k, NLIMB), dtype=np.int32)
+    one = int_to_limbs(R_MONT % P)
+    cache: dict = {}
+    for i, row in enumerate(grids):
+        for j, aff in enumerate(row):
+            if aff is None:
+                continue
+            key = aff[0]
+            ent = cache.get(key)
+            if ent is None:
+                ent = (
+                    int_to_limbs(aff[0] * R_MONT % P),
+                    int_to_limbs(aff[1] * R_MONT % P),
+                )
+                cache[key] = ent
+            xs[i, j] = ent[0]
+            ys[i, j] = ent[1]
+            zs[i, j] = one
+    return jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs)
+
+
+# ---------------------------------------------------------------------------
+# The batch verifier
+# ---------------------------------------------------------------------------
+
+
+def verify_signature_sets_device_full(sets, rng=None) -> bool:
+    """Full-device RLC batch verification. Each set: (signature, pubkeys[],
+    message). Returns True iff every signature is valid (w.h.p.)."""
+    import secrets as _secrets
+
+    from ..crypto import bls
+
+    sets = list(sets)
+    if not sets:
+        return False
+    rand = rng if rng is not None else _secrets.SystemRandom()
+
+    sig_affs = []
+    pk_rows = []
+    scalars = []
+    messages = []
+    for s in sets:
+        try:
+            if s.signature.is_infinity():
+                return False
+            sig_aff = _affine_int(s.signature.point())
+            pk_affs = [_affine_int(pk.point()) for pk in s.pubkeys]
+        except (bls.BlsError, ValueError):
+            return False
+        if sig_aff is None or not pk_affs:
+            return False
+        r = 0
+        while r == 0:
+            r = rand.getrandbits(bls.RAND_BITS)
+        sig_affs.append(sig_aff)
+        pk_rows.append(pk_affs)
+        scalars.append(r)
+        messages.append(s.message)
+
+    n = len(sets)
+    nb = _bucket(n)
+
+    # --- G2 subgroup checks on all signatures (device) ---
+    sig_pad = sig_affs + [None] * (nb - n)
+    qx, qy, q_inf = g2_affine_to_device(sig_pad)
+    in_sub = np.asarray(g2_subgroup_check_device(qx, qy, q_inf))
+    if not bool(in_sub.all()):
+        return False
+
+    # --- per-set pubkey aggregation (device, padded committee axis) ---
+    kmax = _bucket(max(len(r) for r in pk_rows), floor=1)
+    grid = [row + [None] * (kmax - len(row)) for row in pk_rows]
+    grid += [[None] * kmax] * (nb - n)
+    gx, gy, gz = _g1_affine_grid_to_device(grid)
+    agg_x, agg_y, agg_z = g1_segment_sum(gx, gy, gz)
+
+    # --- RLC scalar multiplications (device ladders) ---
+    bits = jnp.asarray(scalars_to_bits(scalars + [0] * (nb - n), bls.RAND_BITS))
+    s_pk = batch_g1_scalar_mul(agg_x, agg_y, agg_z, bits)
+    one2 = jnp.broadcast_to(
+        jnp.stack(
+            [jnp.asarray(fq_to_device([1])[0]), jnp.zeros(NLIMB, jnp.int32)]
+        ),
+        (nb, 2, NLIMB),
+    ).astype(jnp.int32)
+    z_pad = jnp.where(q_inf[:, None, None], jnp.zeros_like(one2), one2)
+    s_sig = batch_g2_scalar_mul(qx, qy, z_pad, bits)
+
+    # --- signature aggregate Σ rᵢ·sigᵢ (device tree-reduce) ---
+    agg_sig = g2_sum_reduce(*s_sig)
+
+    # --- per-message aggregation of scaled pubkeys (device gather+reduce) ---
+    groups: dict[bytes, list[int]] = {}
+    for i, m in enumerate(messages):
+        groups.setdefault(m, []).append(i)
+    msgs = list(groups)
+    m_count = len(msgs)
+    mb = _bucket(m_count, floor=1)
+    gmax = _bucket(max(len(v) for v in groups.values()), floor=1)
+    if nb > n:
+        # lane nb-1 is a padded set (scalar 0 ladder → infinity): reuse it
+        # as the gather pad slot.
+        pad_slot = nb - 1
+    else:
+        # exact-power batch: append an explicit infinity lane.
+        s_pk = tuple(
+            jnp.concatenate([c, jnp.zeros_like(c[-1:])], axis=0) for c in s_pk
+        )
+        pad_slot = nb
+    idx = np.full((mb, gmax), pad_slot, dtype=np.int32)
+    for gi, m in enumerate(msgs):
+        for jj, si in enumerate(groups[m]):
+            idx[gi, jj] = si
+    gx2 = tuple(jnp.take(c, jnp.asarray(idx), axis=0) for c in s_pk)
+    msg_pk = g1_segment_sum(*gx2)
+
+    # --- H(m): device SSWU hash-to-curve ---
+    u = messages_to_field_device(msgs + [b"\x00" * 32] * (mb - m_count))
+    hm = hash_to_g2_device(jnp.asarray(u))
+
+    # --- assemble the multi-pairing: (-G1, agg_sig) + (msg_pk_i, H(m_i)) ---
+    from ..crypto.bls12_381 import FQ, G1_GEN
+    from ..crypto.bls12_381.curve import pt_neg, to_affine
+
+    neg_g1 = to_affine(FQ, pt_neg(FQ, G1_GEN))
+    ngx, ngy, ng_inf = g1_affine_to_device([neg_g1])
+
+    pk_ax, pk_ay, pk_inf = g1_jac_to_affine(*msg_pk)
+    # mask out padded message lanes
+    lane_pad = np.arange(mb) >= m_count
+    pk_inf = pk_inf | jnp.asarray(lane_pad)
+    hm_ax, hm_ay, hm_inf = g2_jac_to_affine(*hm)
+    sig_ax, sig_ay, sig_inf = g2_jac_to_affine(*agg_sig)
+
+    xp = jnp.concatenate([ngx, pk_ax], axis=0)
+    yp = jnp.concatenate([ngy, pk_ay], axis=0)
+    p_inf = jnp.concatenate([ng_inf, pk_inf], axis=0)
+    qx2 = jnp.concatenate([sig_ax, hm_ax], axis=0)
+    qy2 = jnp.concatenate([sig_ay, hm_ay], axis=0)
+    q_inf2 = jnp.concatenate([sig_inf, hm_inf], axis=0)
+    return bool(multi_pairing_check_device(xp, yp, p_inf, qx2, qy2, q_inf2))

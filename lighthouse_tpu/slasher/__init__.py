@@ -1,0 +1,171 @@
+"""Slashing detection engine.
+
+Mirrors `slasher` (src/slasher.rs:79,125): attestations and block headers
+are queued as they arrive (the service feeds gossip in), then
+`process_queued(current_epoch)` runs batched detection — double votes,
+surround votes in both directions, and double proposals — emitting
+ready-to-pool `AttesterSlashing` / `ProposerSlashing` containers. History
+is bounded to `history_length` epochs and pruned as the epoch advances
+(the reference's chunked min/max arrays bound the same window; here the
+per-validator record set stays small enough for direct interval checks,
+the LMDB/MDBX backing store maps to the in-process dict + optional
+snapshot through the KV trait)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..metrics import inc_counter
+
+DEFAULT_HISTORY_LENGTH = 4096
+
+
+@dataclass
+class _AttRecord:
+    source: int
+    target: int
+    data_root: bytes
+    indexed: object  # IndexedAttestation
+
+
+@dataclass
+class _BlockRecord:
+    slot: int
+    header_root: bytes
+    signed_header: object
+
+
+@dataclass
+class SlasherConfig:
+    history_length: int = DEFAULT_HISTORY_LENGTH
+
+
+class Slasher:
+    def __init__(self, E, config: SlasherConfig | None = None):
+        self.E = E
+        self.config = config or SlasherConfig()
+        # validator index -> target epoch -> record (one canonical att per
+        # target; a conflicting second one IS the double vote)
+        self._atts: dict[int, dict[int, _AttRecord]] = {}
+        self._blocks: dict[int, dict[int, _BlockRecord]] = {}
+        self._att_queue: list = []
+        self._block_queue: list = []
+        self.attester_slashings: list = []
+        self.proposer_slashings: list = []
+
+    # -- ingestion (slasher service feed) -------------------------------------
+
+    def accept_attestation(self, indexed_attestation):
+        self._att_queue.append(indexed_attestation)
+
+    def accept_block_header(self, signed_header):
+        self._block_queue.append(signed_header)
+
+    # -- batched processing (slasher.rs:125 process_queued) --------------------
+
+    def process_queued(self, current_epoch: int) -> dict:
+        found_att = 0
+        found_blk = 0
+        for indexed in self._att_queue:
+            found_att += self._process_attestation(indexed)
+        for header in self._block_queue:
+            found_blk += self._process_block(header)
+        self._att_queue.clear()
+        self._block_queue.clear()
+        self._prune(current_epoch)
+        if found_att:
+            inc_counter("slasher_attester_slashings_found", amount=found_att)
+        if found_blk:
+            inc_counter("slasher_proposer_slashings_found", amount=found_blk)
+        return {"attester_slashings": found_att, "proposer_slashings": found_blk}
+
+    def _process_attestation(self, indexed) -> int:
+        data = indexed.data
+        s2, t2 = int(data.source.epoch), int(data.target.epoch)
+        root2 = data.hash_tree_root()
+        found = 0
+        for vi in indexed.attesting_indices:
+            vi = int(vi)
+            records = self._atts.setdefault(vi, {})
+            prev = records.get(t2)
+            if prev is not None:
+                if prev.data_root != root2:
+                    self._emit_attester_slashing(prev.indexed, indexed)
+                    found += 1
+                continue  # same vote (or slashing emitted); nothing to record
+            # surround checks against every recorded vote in the window.
+            # attestation_1 must SURROUND attestation_2
+            # (is_slashable_attestation_data: s1 < s2 and t2 < t1), so the
+            # emit order depends on which vote is the surrounder.
+            hit = None
+            for rec in records.values():
+                if rec.source < s2 and t2 < rec.target:
+                    hit = (rec.indexed, indexed)  # old surrounds new
+                    break
+                if s2 < rec.source and rec.target < t2:
+                    hit = (indexed, rec.indexed)  # new surrounds old
+                    break
+            if hit is not None:
+                self._emit_attester_slashing(*hit)
+                found += 1
+            records[t2] = _AttRecord(s2, t2, root2, indexed)
+        return found
+
+    def _process_block(self, signed_header) -> int:
+        h = signed_header.message
+        proposer = int(h.proposer_index)
+        slot = int(h.slot)
+        root = h.hash_tree_root()
+        blocks = self._blocks.setdefault(proposer, {})
+        prev = blocks.get(slot)
+        if prev is None:
+            blocks[slot] = _BlockRecord(slot, root, signed_header)
+            return 0
+        if prev.header_root == root:
+            return 0
+        self._emit_proposer_slashing(prev.signed_header, signed_header)
+        return 1
+
+    # -- slashing construction -------------------------------------------------
+
+    def _emit_attester_slashing(self, att1, att2):
+        from ..types.containers import build_types
+
+        t = build_types(self.E)
+        self.attester_slashings.append(
+            t.AttesterSlashing(attestation_1=att1, attestation_2=att2)
+        )
+
+    def _emit_proposer_slashing(self, h1, h2):
+        from ..types.containers import build_types
+
+        t = build_types(self.E)
+        self.proposer_slashings.append(
+            t.ProposerSlashing(signed_header_1=h1, signed_header_2=h2)
+        )
+
+    # -- pruning ---------------------------------------------------------------
+
+    def _prune(self, current_epoch: int):
+        floor = max(0, current_epoch - self.config.history_length)
+        slot_floor = floor * self.E.SLOTS_PER_EPOCH
+        for vi in list(self._atts):
+            recs = self._atts[vi]
+            for t in [t for t in recs if t < floor]:
+                del recs[t]
+            if not recs:
+                del self._atts[vi]
+        for vi in list(self._blocks):
+            blks = self._blocks[vi]
+            for s in [s for s in blks if s < slot_floor]:
+                del blks[s]
+            if not blks:
+                del self._blocks[vi]
+
+    # -- op-pool handoff (slasher/service feeds the pool) -----------------------
+
+    def drain_slashings(self):
+        atts, props = self.attester_slashings, self.proposer_slashings
+        self.attester_slashings = []
+        self.proposer_slashings = []
+        return atts, props

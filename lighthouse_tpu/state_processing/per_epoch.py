@@ -328,46 +328,86 @@ def process_rewards_and_penalties(state, spec: ChainSpec, E):
 # ---------------------------------------------------------------------------
 
 
-def process_registry_updates(state, spec: ChainSpec, E):
+def process_registry_updates(state, spec: ChainSpec, E, arrays=None):
+    """Vectorized registry sweep (single_pass.rs:20 shape): eligibility,
+    ejections, and the activation queue come from flat-array masks; only
+    the (typically few) touched validators are written back. Returns the
+    list of mutated validator indices so callers can refresh array
+    snapshots in place instead of rebuilding."""
+    import numpy as np
+
     from ..types.chain_spec import ForkName
     from ..types.containers import build_types
 
     fork = build_types(E).fork_of_state(state)
     current = get_current_epoch(state, E)
     electra = fork >= ForkName.ELECTRA
-    for index, v in enumerate(state.validators):
-        if electra:
-            # EIP-7251: eligibility at MIN_ACTIVATION_BALANCE
-            eligible = (
-                v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
-                and v.effective_balance >= spec.min_activation_balance
-            )
-        else:
-            eligible = is_eligible_for_activation_queue(v, E)
-        if eligible:
-            v.activation_eligibility_epoch = current + 1
-        if is_active_validator(v, current) and v.effective_balance <= spec.ejection_balance:
-            initiate_validator_exit(state, index, spec, E)
-    activation_queue = sorted(
-        (
-            i
-            for i, v in enumerate(state.validators)
-            if is_eligible_for_activation(state, v)
-        ),
-        key=lambda i: (state.validators[i].activation_eligibility_epoch, i),
-    )
+    vs = state.validators
+    n = len(vs)
+
+    if arrays is not None:
+        eligibility = np.fromiter(
+            (v.activation_eligibility_epoch for v in vs), dtype=np.uint64, count=n
+        )
+        effective = arrays.effective_balance
+        activation = arrays.activation_epoch
+        exit_ep = arrays.exit_epoch
+    else:
+        eligibility = np.fromiter(
+            (v.activation_eligibility_epoch for v in vs), dtype=np.uint64, count=n
+        )
+        effective = np.fromiter(
+            (v.effective_balance for v in vs), dtype=np.uint64, count=n
+        )
+        activation = np.fromiter(
+            (v.activation_epoch for v in vs), dtype=np.uint64, count=n
+        )
+        exit_ep = np.fromiter((v.exit_epoch for v in vs), dtype=np.uint64, count=n)
+
+    far = np.uint64(FAR_FUTURE_EPOCH)
+    cur = np.uint64(current)
+    changed: set[int] = set()
+
+    # eligibility for the activation queue
+    if electra:
+        new_eligible = (eligibility == far) & (
+            effective >= np.uint64(spec.min_activation_balance)
+        )
+    else:
+        new_eligible = (eligibility == far) & (
+            effective == np.uint64(E.MAX_EFFECTIVE_BALANCE)
+        )
+    for i in np.nonzero(new_eligible)[0]:
+        vs[int(i)].activation_eligibility_epoch = current + 1
+        eligibility[i] = current + 1
+        changed.add(int(i))
+
+    # ejections (active + effective balance at/below the floor)
+    active_mask = (activation <= cur) & (cur < exit_ep)
+    ejectable = active_mask & (effective <= np.uint64(spec.ejection_balance))
+    for i in np.nonzero(ejectable)[0]:
+        initiate_validator_exit(state, int(i), spec, E)
+        changed.add(int(i))
+
+    # activation queue: eligibility finalized + not yet scheduled
+    finalized = np.uint64(state.finalized_checkpoint.epoch)
+    queue_mask = (eligibility <= finalized) & (activation == far)
+    queue_idx = np.nonzero(queue_mask)[0]
+    order = np.lexsort((queue_idx, eligibility[queue_idx]))
+    activation_queue = queue_idx[order]
     if electra:
         # EIP-7251: activations are unbounded by count — the balance churn
         # is enforced upstream by the pending-deposit queue.
         limit = len(activation_queue)
     else:
         # Deneb (EIP-7514) caps the activation churn; exit churn is uncapped.
-        active_count = len(get_active_validator_indices(state, current))
+        active_count = int(active_mask.sum())
         limit = spec.activation_churn_limit(active_count, fork)
-    for index in activation_queue[:limit]:
-        state.validators[index].activation_epoch = compute_activation_exit_epoch(
-            current, E
-        )
+    target = compute_activation_exit_epoch(current, E)
+    for i in activation_queue[:limit]:
+        vs[int(i)].activation_epoch = target
+        changed.add(int(i))
+    return sorted(changed)
 
 
 def process_slashings(state, E):
@@ -391,17 +431,35 @@ def process_eth1_data_reset(state, E):
         state.eth1_data_votes = []
 
 
-def process_effective_balance_updates(state, E):
+def process_effective_balance_updates(state, E, arrays=None):
+    """Hysteresis sweep as one vectorized pass; only out-of-band validators
+    (a handful per epoch in steady state) get object writebacks."""
+    import numpy as np
+
+    n = len(state.validators)
+    balances = np.asarray(state.balances, dtype=np.uint64)
+    if arrays is not None:
+        effective = arrays.effective_balance
+    else:
+        effective = np.fromiter(
+            (v.effective_balance for v in state.validators),
+            dtype=np.uint64,
+            count=n,
+        )
     hysteresis_increment = E.EFFECTIVE_BALANCE_INCREMENT // E.HYSTERESIS_QUOTIENT
-    downward = hysteresis_increment * E.HYSTERESIS_DOWNWARD_MULTIPLIER
-    upward = hysteresis_increment * E.HYSTERESIS_UPWARD_MULTIPLIER
-    for index, v in enumerate(state.validators):
-        balance = state.balances[index]
-        if balance + downward < v.effective_balance or v.effective_balance + upward < balance:
-            v.effective_balance = min(
-                balance - balance % E.EFFECTIVE_BALANCE_INCREMENT,
-                E.MAX_EFFECTIVE_BALANCE,
-            )
+    downward = np.uint64(hysteresis_increment * E.HYSTERESIS_DOWNWARD_MULTIPLIER)
+    upward = np.uint64(hysteresis_increment * E.HYSTERESIS_UPWARD_MULTIPLIER)
+    stale = (balances + downward < effective) | (effective + upward < balances)
+    if not stale.any():
+        return
+    increment = np.uint64(E.EFFECTIVE_BALANCE_INCREMENT)
+    new_eff = np.minimum(
+        balances - balances % increment, np.uint64(E.MAX_EFFECTIVE_BALANCE)
+    )
+    for i in np.nonzero(stale)[0]:
+        state.validators[int(i)].effective_balance = int(new_eff[i])
+        if arrays is not None:
+            arrays.effective_balance[i] = new_eff[i]
 
 
 def process_slashings_reset(state, E):

@@ -568,12 +568,31 @@ def build_types(E: type) -> SimpleNamespace:
             )
         return ns
 
+    def decode_by_fork(kind: str, data: bytes):
+        """Resolve an SSZ blob's fork variant by decoding newest-first and
+        accepting on exact re-serialization (sibling fork layouts can both
+        decode loosely; the byte-exact roundtrip disambiguates). `kind` is
+        the per-fork attribute name, e.g. "SignedBeaconBlock"/"BeaconState".
+        Raises ValueError when no fork matches."""
+        for fork in reversed(list(forks)):
+            cls = getattr(forks[fork], kind, None)
+            if cls is None:
+                continue
+            try:
+                obj = cls.deserialize(data)
+            except Exception:  # noqa: BLE001 — not this fork's layout
+                continue
+            if cls.serialize_value(obj) == data:
+                return obj
+        raise ValueError(f"data does not decode as {kind} under any fork")
+
     return SimpleNamespace(
         preset=E,
         forks=forks,
         fork_of_state=fork_of_state,
         fork_of_block=fork_of_block,
         types_for_fork=types_for_fork,
+        decode_by_fork=decode_by_fork,
         # phase0 family (flat access for the common case)
         Fork=Fork,
         ForkData=ForkData,

@@ -146,6 +146,9 @@ class DutiesService:
         self.node = node
         self.spec = spec
         self.E = E
+        # duty cache per (epoch, dependent root) — recomputed only on reorg
+        # or epoch change (the reference polls once per epoch the same way)
+        self._duty_cache: dict = {}
 
     def _our_indices(self, state) -> dict[int, bytes]:
         ours = {}
@@ -160,6 +163,10 @@ class DutiesService:
         from ..state_processing.accessors import compute_start_slot_at_epoch
 
         state = self.node.head_state()
+        key = (epoch, getattr(self.node, "head_root", lambda: None)())
+        cached = self._duty_cache.get(key)
+        if cached is not None:
+            return cached
         ours = self._our_indices(state)
         cc = committee_cache_at(state, epoch, self.E)
         start = compute_start_slot_at_epoch(epoch, self.E)
@@ -178,6 +185,9 @@ class DutiesService:
                                 committee_size=len(committee),
                             )
                         )
+        self._duty_cache[key] = duties
+        if len(self._duty_cache) > 4:
+            self._duty_cache.pop(next(iter(self._duty_cache)))
         return duties
 
     def proposer_duty_at(self, slot: int):

@@ -1,0 +1,77 @@
+"""Umbrella CLI (lighthouse binary / lcli / database_manager analogs)."""
+
+import json
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.cli import main
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_processing import interop_genesis_state
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+
+@pytest.fixture()
+def state_file(tmp_path):
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    kps = bls.interop_keypairs(8)
+    st = interop_genesis_state(kps, 1_600_000_000, b"\x42" * 32, spec, E)
+    p = tmp_path / "state.ssz"
+    p.write_bytes(st.serialize())
+    return p, st
+
+
+def test_state_root_cmd(state_file, capsys):
+    p, st = state_file
+    assert main(["--spec", "minimal", "state-root", str(p)]) == 0
+    out = capsys.readouterr().out.strip()
+    assert out == "0x" + st.hash_tree_root().hex()
+
+
+def test_pretty_ssz_cmd(state_file, capsys):
+    p, st = state_file
+    assert main(["--spec", "minimal", "pretty-ssz", "state", str(p)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["slot"] == 0
+    assert doc["fork"]["current_version"].startswith("0x")
+
+
+def test_skip_slots_cmd(state_file, tmp_path, capsys):
+    p, st = state_file
+    out = tmp_path / "advanced.ssz"
+    assert (
+        main(
+            ["--spec", "minimal", "skip-slots", str(p), "5", "--output", str(out)]
+        )
+        == 0
+    )
+    from lighthouse_tpu.types.containers import build_types
+
+    advanced = build_types(E).types_for_fork(
+        build_types(E).fork_of_state(st)
+    ).BeaconState.deserialize(out.read_bytes())
+    assert advanced.slot == 5
+
+
+def test_db_cmds(tmp_path, capsys):
+    from lighthouse_tpu.store import HotColdDB
+    from lighthouse_tpu.store.kv import SqliteStore
+    from lighthouse_tpu.types.containers import build_types
+
+    path = str(tmp_path / "db.sqlite")
+    HotColdDB(SqliteStore(path), types=build_types(E)).hot.close()
+    assert main(["db", "version", path]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["compatible"] is True
+    assert main(["db", "inspect", path]) == 0
+    inspect = json.loads(capsys.readouterr().out)
+    assert "beacon_block" in inspect
+    assert main(["db", "migrate", path]) == 0
+
+
+def test_interop_keys_cmd(capsys):
+    assert main(["interop-keys", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "a99a76ed7796f7be22d5b7e8" in out  # well-known interop pk 0
