@@ -230,6 +230,30 @@ def cmd_db(args):
         store.close()
 
 
+def cmd_vm(args):
+    """validator_manager / account_manager: create / list / import."""
+    from . import validator_manager as VM
+
+    if args.vm_cmd == "create":
+        spec, E = _load_spec(args.spec)
+        records = VM.create_validators(
+            bytes.fromhex(args.seed.removeprefix("0x")),
+            args.count,
+            args.dir,
+            args.password,
+            spec=spec,
+            E=E,
+            fast_kdf=args.fast_kdf,
+        )
+        print(json.dumps({"created": len(records), "dir": args.dir}))
+    elif args.vm_cmd == "list":
+        print(json.dumps(VM.list_validators(args.dir), indent=2))
+    elif args.vm_cmd == "import":
+        pk = VM.import_keystore(args.keystore, args.password, args.dir)
+        print(json.dumps({"imported": pk.hex()}))
+    return 0
+
+
 def cmd_interop_keys(args):
     """Print deterministic interop keypairs (eth2_interop_keypairs)."""
     from .crypto import bls
@@ -298,6 +322,16 @@ def build_parser() -> argparse.ArgumentParser:
     ik = sub.add_parser("interop-keys", help="deterministic test keypairs")
     ik.add_argument("count", type=int)
     ik.set_defaults(fn=cmd_interop_keys)
+
+    vm = sub.add_parser("vm", help="validator manager")
+    vm.add_argument("vm_cmd", choices=["create", "list", "import"])
+    vm.add_argument("dir")
+    vm.add_argument("--count", type=int, default=1)
+    vm.add_argument("--seed", default="42" * 32)
+    vm.add_argument("--password", default="")
+    vm.add_argument("--keystore")
+    vm.add_argument("--fast-kdf", action="store_true", help="test-grade KDF cost")
+    vm.set_defaults(fn=cmd_vm)
 
     return p
 

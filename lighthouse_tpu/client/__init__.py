@@ -1,0 +1,158 @@
+"""Node assembly: ClientBuilder + the running Client.
+
+Mirrors beacon_node/client (src/builder.rs:109-787): a staged builder
+wiring store → genesis → chain → execution layer → network → HTTP API →
+slot timer → validator client, producing a `Client` whose lifecycle the
+CLI (or tests) drive. Genesis options mirror `ClientGenesis`
+(src/config.rs:21-41): interop keys, a provided state (checkpoint sync),
+or resume-from-store."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..beacon_chain.chain import BeaconChain
+from ..beacon_chain.timer import SlotTimer
+from ..crypto import bls
+from ..metrics import set_gauge
+from ..state_processing import interop_genesis_state
+from ..store import HotColdDB, MemoryStore
+from ..store.kv import SqliteStore
+from ..utils.logging import get_logger
+from ..utils.slot_clock import ManualSlotClock, SystemTimeSlotClock
+from ..utils.task_executor import ShutdownSignal, TaskExecutor
+
+log = get_logger("lighthouse_tpu.client")
+
+
+@dataclass
+class ClientConfig:
+    spec: object = None
+    E: object = None
+    db_path: str | None = None  # None = MemoryStore
+    http_port: int | None = 0  # None = disabled
+    network_port: int | None = 0  # None = disabled
+    validator_count: int = 16  # interop genesis size
+    validate: bool = False  # run an in-process VC over the interop keys
+    mock_execution_layer: bool = True
+    manual_slot_clock: bool = True  # tests drive slots by hand
+    genesis_state: object = None  # checkpoint-sync style provided state
+    genesis_time: int = 1_600_000_000
+
+
+class Client:
+    def __init__(self):
+        self.chain: BeaconChain | None = None
+        self.network = None
+        self.http_server = None
+        self.timer: SlotTimer | None = None
+        self.vc = None
+        self.slot_clock = None
+        self.executor = TaskExecutor(ShutdownSignal())
+        self.keypairs = []
+
+    def start(self):
+        if self.network is not None:
+            self.network.start()
+        if self.http_server is not None:
+            self.http_server.start()
+        if self.timer is not None and not isinstance(
+            self.slot_clock, ManualSlotClock
+        ):
+            self.timer.start()
+        return self
+
+    def on_slot(self, slot: int):
+        """Manual-clock driving (tests / simulator)."""
+        if isinstance(self.slot_clock, ManualSlotClock):
+            self.slot_clock.set_slot(slot)
+        if self.vc is not None:
+            self.vc.on_slot(slot)
+        set_gauge("beacon_head_slot", self.chain.head_state.slot)
+
+    def stop(self):
+        if self.timer is not None:
+            self.timer.stop()
+        if self.network is not None:
+            self.network.stop()
+        if self.http_server is not None:
+            self.http_server.stop()
+        self.executor.shutdown_signal.trigger("client stop")
+
+
+class ClientBuilder:
+    """builder.rs staged construction, collapsed to the pieces this node
+    has (disk_store :1043 → beacon_chain :158 → network :644 → http :703 →
+    build :787)."""
+
+    def __init__(self, config: ClientConfig):
+        self.config = config
+        self.client = Client()
+
+    def build(self) -> Client:
+        cfg = self.config
+        c = self.client
+        # store
+        if cfg.db_path:
+            store = HotColdDB(SqliteStore(cfg.db_path))
+        else:
+            store = HotColdDB(MemoryStore())
+        # genesis
+        c.keypairs = bls.interop_keypairs(cfg.validator_count)
+        if cfg.genesis_state is not None:
+            genesis_state = cfg.genesis_state
+        else:
+            genesis_state = interop_genesis_state(
+                c.keypairs, cfg.genesis_time, b"\x42" * 32, cfg.spec, cfg.E
+            )
+        # clocks
+        if cfg.manual_slot_clock:
+            c.slot_clock = ManualSlotClock(
+                genesis_time=genesis_state.genesis_time,
+                seconds_per_slot=cfg.spec.seconds_per_slot,
+            )
+        else:
+            c.slot_clock = SystemTimeSlotClock(
+                genesis_time=genesis_state.genesis_time,
+                seconds_per_slot=cfg.spec.seconds_per_slot,
+            )
+        # execution layer
+        execution_layer = None
+        if cfg.mock_execution_layer:
+            from ..execution_layer import MockExecutionLayer
+            from ..types.containers import build_types
+
+            execution_layer = MockExecutionLayer(build_types(cfg.E), cfg.E)
+        # chain
+        c.chain = BeaconChain(
+            store=store,
+            genesis_state=genesis_state,
+            spec=cfg.spec,
+            E=cfg.E,
+            slot_clock=c.slot_clock,
+            execution_layer=execution_layer,
+        )
+        # network
+        if cfg.network_port is not None:
+            from ..network import NetworkService
+
+            c.network = NetworkService(c.chain, port=cfg.network_port)
+        # http
+        if cfg.http_port is not None:
+            from ..http_api import HttpApiServer
+
+            c.http_server = HttpApiServer(c.chain, port=cfg.http_port)
+        # validator client
+        if cfg.validate:
+            from ..validator_client import ValidatorClient
+
+            c.vc = ValidatorClient(c.chain, c.keypairs, cfg.spec, cfg.E)
+        # timer
+        c.timer = SlotTimer(c.slot_clock, c.on_slot, executor=c.executor)
+        log.info(
+            "client built",
+            validators=cfg.validator_count,
+            http=bool(c.http_server),
+            network=bool(c.network),
+        )
+        return c

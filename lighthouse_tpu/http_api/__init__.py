@@ -62,10 +62,10 @@ class BeaconApi:
     def __init__(self, chain, validator_client=None):
         self.chain = chain
         self.vc = validator_client
-        # genesis facts survive snapshot-cache pruning at finality
-        gstate = chain._states[chain.genesis_block_root]
-        self._genesis_time = int(gstate.genesis_time)
-        self._genesis_validators_root = bytes(gstate.genesis_validators_root)
+        # genesis facts from chain invariants — never from the prunable
+        # snapshot cache (the API may be constructed after finality)
+        self._genesis_time = int(chain.head_state.genesis_time)
+        self._genesis_validators_root = bytes(chain.genesis_validators_root)
 
     # -- state resolution ----------------------------------------------------
 
@@ -215,6 +215,38 @@ class BeaconApi:
         root, _ = self._block(block_id)
         return {"data": {"root": _hex(root)}}
 
+    def debug_state_ssz(self, state_id: str) -> bytes:
+        """/eth/v2/debug/beacon/states/{id} (SSZ) — what checkpoint sync
+        and the HTTP-backed VC pull."""
+        return self._state(state_id).serialize()
+
+    def produce_block_ssz(self, slot: int, randao_reveal: bytes) -> bytes:
+        block, _post = self.chain.produce_block_on_state(slot, randao_reveal)
+        return block.serialize()
+
+    def publish_attestations_ssz(self, data: bytes) -> int:
+        """POST /eth/v1/beacon/pool/attestations with an SSZ-encoded
+        Attestation list (the standard route takes JSON; SSZ here keeps
+        the codec shared with gossip)."""
+        t = self.chain.types
+        from ..ssz.core import List as SszList
+
+        atts = SszList[t.Attestation, 1024].deserialize(data)
+        results = self.chain.process_attestation_batch(list(atts))
+        failures = [r for r in results if isinstance(r, Exception)]
+        inc_counter("http_api_attestations_received", amount=len(atts))
+        if failures and len(failures) == len(atts):
+            raise ApiError(400, f"all attestations rejected: {failures[0]}")
+        if failures:
+            # Beacon API partial-failure contract: the client must learn
+            # which duties were dropped
+            raise ApiError(
+                202,
+                f"{len(failures)}/{len(atts)} attestations rejected: "
+                f"{failures[0]}",
+            )
+        return 200
+
     def publish_block_ssz(self, data: bytes) -> int:
         # Resolve the fork first (exact-roundtrip decode), THEN import
         # exactly once so a genuine rejection surfaces as itself and never
@@ -331,6 +363,20 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send_json(self.api.block_header(m.group("block_id")))
                 return
+            m = re.match(r"^/eth/v2/debug/beacon/states/(?P<state_id>[^/]+)$", path)
+            if m:
+                self._send_bytes(self.api.debug_state_ssz(m.group("state_id")))
+                return
+            m = re.match(r"^/eth/v3/validator/blocks/(?P<slot>\d+)$", path)
+            if m:
+                q = parse_qs(parsed.query)
+                reveal = bytes.fromhex(
+                    q.get("randao_reveal", ["00" * 96])[0].removeprefix("0x")
+                )
+                self._send_bytes(
+                    self.api.produce_block_ssz(int(m.group("slot")), reveal)
+                )
+                return
             for method, pattern, fn_name in _ROUTES:
                 if method != "GET":
                     continue
@@ -371,6 +417,10 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"code": code, "message": "ok"}, code)
                     return
                 raise ApiError(415, "JSON block publishing not supported; use SSZ")
+            if path == "/eth/v1/beacon/pool/attestations":
+                code = self.api.publish_attestations_ssz(body)
+                self._send_json({"code": code, "message": "ok"}, code)
+                return
             raise ApiError(404, f"unknown route {path}")
         except ApiError as e:
             self._send_json({"code": e.code, "message": e.message}, e.code)

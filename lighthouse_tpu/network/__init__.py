@@ -121,8 +121,9 @@ class GossipRouter:
             return True
 
     def publish(self, topic: str, data: bytes, origin: str | None = None):
-        """Deliver locally (unless we originated it) and forward to every
-        connected peer except the origin."""
+        """Validate-then-forward (gossipsub accept/reject semantics): a
+        message our handler rejects is NOT relayed, so invalid data never
+        costs downstream peers score."""
         mid = M.message_id(self.service.spec.message_domain_valid_snappy, data)
         if not self._first_sight(mid):
             return
@@ -133,14 +134,17 @@ class GossipRouter:
                 try:
                     handler(data)
                     self.service.peers.report(origin, SCORE_TIMELY_MESSAGE)
-                except Exception:  # noqa: BLE001 — invalid gossip
+                except Exception:  # noqa: BLE001 — invalid gossip: reject
                     self.service.peers.report(origin, SCORE_INVALID_MESSAGE)
                     inc_counter("gossip_invalid_total")
+                    return
         for peer in self.service.peers.peers():
-            if peer.peer_id == origin or peer.gossip_sock is None:
+            if peer.peer_id == origin:
                 continue
             try:
                 with peer.lock:
+                    if peer.gossip_sock is None:
+                        continue
                     _send_block(peer.gossip_sock, _frame_topic(topic) + data)
             except OSError:
                 self.service._drop_peer(peer)
@@ -258,6 +262,9 @@ class NetworkService:
             raise RpcError("peer on a different fork digest")
         peer = Peer(host=host, port=port, client=client, status=status)
         peer.gossip_sock = socket.create_connection((host, port), timeout=10)
+        # persistent stream: clear the connect timeout or an idle 10s kills
+        # the reader with TimeoutError and the peer silently goes deaf
+        peer.gossip_sock.settimeout(None)
         _send_protocol(peer.gossip_sock, M.PROTO_GOSSIP)
         # announce our listening port so the peer can identify us
         _send_block(peer.gossip_sock, self.port.to_bytes(4, "little"))
@@ -272,12 +279,13 @@ class NetworkService:
         return peer
 
     def _drop_peer(self, peer: Peer):
-        if peer.gossip_sock is not None:
-            try:
-                peer.gossip_sock.close()
-            except OSError:
-                pass
-            peer.gossip_sock = None
+        with peer.lock:  # publish checks/uses the socket under this lock
+            if peer.gossip_sock is not None:
+                try:
+                    peer.gossip_sock.close()
+                except OSError:
+                    pass
+                peer.gossip_sock = None
         self.peers.remove(peer.peer_id)
 
     # -- gossip plumbing --------------------------------------------------------

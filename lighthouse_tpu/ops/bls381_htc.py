@@ -305,7 +305,7 @@ def _jit_pair_add(px, py, n: int):
     return pt_add(DevFq2, q0, q1)
 
 
-_jit_clear_cofactor = jax.jit(g2_clear_cofactor_device)
+# g2_clear_cofactor_device orchestrates its own staged jits
 
 
 def hash_to_g2_device(u):
@@ -323,7 +323,7 @@ def hash_to_g2_device(u):
     x, y = map_to_curve_sswu_device(flat)
     px, py = isogeny_to_e2_device(x, y)
     s = _jit_pair_add(px, py, n)
-    return _jit_clear_cofactor(s)
+    return g2_clear_cofactor_device(s)
 
 
 def messages_to_field_device(messages, dst: bytes = HH.DST_G2_POP) -> np.ndarray:

@@ -290,14 +290,19 @@ def final_exp_cubed(F):
 
 
 @jax.jit
-def _jit_mask_and_reduce(f, p_inf, q_inf):
-    """Infinity lanes → identity, then tree-product to [1] Fq12."""
+def _jit_mask(f, p_inf, q_inf):
     skip = p_inf | q_inf
-    f = f12_select(skip, f12_ones(f.shape[:-4]), f)
+    return f12_select(skip, f12_ones(f.shape[:-4]), f)
+
+
+def _mask_and_reduce(f, p_inf, q_inf):
+    """Infinity lanes → identity, then tree-product to [1] Fq12 — one
+    shared batch f12_mul jit per halving level (log n dispatches)."""
+    f = _jit_mask(f, p_inf, q_inf)
     n = f.shape[0]
     while n > 1:
         half = n // 2
-        merged = f12_mul(f[:half], f[half : 2 * half])
+        merged = _jit_f12_mul(f[:half], f[half : 2 * half])
         if n % 2:
             merged = jnp.concatenate([merged, f[-1:]], axis=0)
         f = merged
@@ -309,7 +314,7 @@ def multi_pairing_check_device(xp, yp, p_inf, q_x, q_y, q_inf):
     """∏ e(P_i, Q_i) == 1 over the batch, entirely on device. Infinity
     lanes contribute the identity (host oracle behavior)."""
     f = _miller_jit(xp, yp, q_x, q_y)
-    F = _jit_mask_and_reduce(f, p_inf, q_inf)
+    F = _mask_and_reduce(f, p_inf, q_inf)
     return f12_is_one(final_exp_cubed(F))[0]
 
 
@@ -346,9 +351,17 @@ def _psi_jac(T):
 
 def _ladder_mul_const(T, bits_msb_first: np.ndarray):
     """[k]T for a fixed scalar via left-to-right double-and-add (branchless
-    scan over static bits; T is Jacobian Fq2 batched)."""
-    bits = jnp.asarray(bits_msb_first)
-    batch = T[0].shape[:-2]
+    scan; bits ride as a runtime argument so ONE compiled scan serves every
+    fixed scalar of the same width — see `_jit_ladder`)."""
+    return _jit_ladder(*T, jnp.asarray(bits_msb_first[1:]))
+
+
+@jax.jit
+def _jit_ladder(Tx, Ty, Tz, tail_bits):
+    """Left-to-right ladder: acc starts at T (the leading 1 bit), then one
+    double(+conditional add of T) per remaining bit."""
+    T = (Tx, Ty, Tz)
+    batch = Tx.shape[:-2]
 
     def body(acc, bit):
         acc = pt_double(DevFq2, acc)
@@ -357,8 +370,18 @@ def _ladder_mul_const(T, bits_msb_first: np.ndarray):
         acc = tuple(f2_select(take, a, b) for a, b in zip(added, acc))
         return acc, None
 
-    acc, _ = lax.scan(body, T, bits[1:])  # leading bit: acc starts at T
+    acc, _ = lax.scan(body, T, tail_bits)
     return acc
+
+
+# small shared point jits (straight-line pieces stay out of mega-graphs —
+# a single fused cofactor/hash graph made XLA-CPU's LLVM stage blow up
+# superlinearly on slow hosts)
+
+_jit_pt_add_g2 = jax.jit(lambda ax, ay, az, bx, by, bz: pt_add(
+    DevFq2, (ax, ay, az), (bx, by, bz)
+))
+_jit_pt_double_g2 = jax.jit(lambda x, y, z: pt_double(DevFq2, (x, y, z)))
 
 
 _ATE_BITS_MSB = np.array([int(b) for b in bin(_ATE)[2:]], dtype=np.int32)
@@ -383,16 +406,21 @@ def g2_subgroup_check_device(q_x, q_y, q_inf):
 # ---------------------------------------------------------------------------
 
 
+_jit_neg_y = jax.jit(lambda x, y, z: (x, f2_neg(y), z))
+_jit_psi_jac = jax.jit(lambda x, y, z: _psi_jac((x, y, z)))
+
+
 def g2_clear_cofactor_device(T):
     """Jacobian twisted point(s) → subgroup point(s); 2 x-ladders + 3 ψ
-    instead of a 636-bit scalar multiplication."""
+    instead of a 636-bit scalar multiplication. Python orchestration over
+    the shared ladder/point jits."""
     a = _ladder_mul_const(T, _ATE_BITS_MSB)           # [|x|]Q
-    a = (a[0], f2_neg(a[1]), a[2])                    # [x]Q
-    negT = (T[0], f2_neg(T[1]), T[2])
-    c1 = pt_add(DevFq2, a, negT)                      # [x−1]Q
+    a = _jit_neg_y(*a)                                # [x]Q
+    negT = _jit_neg_y(*T)
+    c1 = _jit_pt_add_g2(*a, *negT)                    # [x−1]Q
     c2 = _ladder_mul_const(c1, _ATE_BITS_MSB)
-    c2 = (c2[0], f2_neg(c2[1]), c2[2])                # [x²−x]Q
-    c3 = pt_add(DevFq2, c2, negT)                     # [x²−x−1]Q
-    out = pt_add(DevFq2, c3, _psi_jac(c1))
-    two_q = pt_double(DevFq2, T)
-    return pt_add(DevFq2, out, _psi_jac(_psi_jac(two_q)))
+    c2 = _jit_neg_y(*c2)                              # [x²−x]Q
+    c3 = _jit_pt_add_g2(*c2, *negT)                   # [x²−x−1]Q
+    out = _jit_pt_add_g2(*c3, *_jit_psi_jac(*c1))
+    two_q = _jit_pt_double_g2(*T)
+    return _jit_pt_add_g2(*out, *_jit_psi_jac(*_jit_psi_jac(*two_q)))

@@ -63,37 +63,51 @@ from .bls381_pairing import (
 # ---------------------------------------------------------------------------
 
 
-def _tree_reduce_axis1(F, pt):
-    """Tree-sum points along axis 1: coords [n, k, ...] → [n, ...]."""
+def _tree_reduce_scan(F, pt):
+    """Tree-sum points along axis 1 (coords [n, k, ...], k a power of two,
+    infinity pads) → [n, ...].
+
+    Shape-stable formulation: one lax.scan whose body does a single
+    [n, k/2]-lane batched pt_add of even/odd columns and re-pads with
+    infinity — the buffer shape never changes, so the whole reduction is
+    ONE compiled scan (unrolling the tree into straight-line adds, or one
+    jit per level, both made XLA-CPU compiles explode)."""
     k = pt[0].shape[1]
-    while k > 1:
-        half = k // 2
-        lo = tuple(c[:, :half] for c in pt)
-        hi = tuple(c[:, half : 2 * half] for c in pt)
-        merged = pt_add(F, lo, hi)
-        if k % 2:
-            pt = tuple(
-                jnp.concatenate([m, c[:, -1:]], axis=1) for m, c in zip(merged, pt)
-            )
-            k = half + 1
-        else:
-            pt = merged
-            k = half
-    return tuple(c[:, 0] for c in pt)
+    assert k & (k - 1) == 0, "tree reduce needs a power-of-two lane count"
+    if k == 1:
+        return tuple(c[:, 0] for c in pt)
+    depth = (k - 1).bit_length()
+
+    def body(buf, _):
+        lo = tuple(c[:, 0::2] for c in buf)
+        hi = tuple(c[:, 1::2] for c in buf)
+        merged = pt_add(F, lo, hi)  # [n, k/2, ...]
+        # re-pad to [n, k]: infinity (z=0) lanes are absorbed by pt_add
+        buf = tuple(
+            jnp.concatenate([m, jnp.zeros_like(m)], axis=1) for m in merged
+        )
+        return buf, None
+
+    buf, _ = lax.scan(body, pt, None, length=depth)
+    return tuple(c[:, 0] for c in buf)
 
 
-@jax.jit
+_jit_tree_reduce_g1 = jax.jit(
+    lambda xs, ys, zs: _tree_reduce_scan(DevFq, (xs, ys, zs))
+)
+_jit_tree_reduce_g2 = jax.jit(
+    lambda xs, ys, zs: _tree_reduce_scan(DevFq2, (xs, ys, zs))
+)
+
+
 def g1_segment_sum(xs, ys, zs):
     """[n, k] padded G1 points (infinity pads) → [n] sums."""
-    return _tree_reduce_axis1(DevFq, (xs, ys, zs))
+    return _jit_tree_reduce_g1(xs, ys, zs)
 
 
-@jax.jit
 def g2_sum_reduce(xs, ys, zs):
     """Tree-reduce a batch of G2 points to a single sum ([n] → [1])."""
-    pt = (xs[None], ys[None], zs[None])  # [1, n, ...]
-    out = _tree_reduce_axis1(DevFq2, pt)
-    return tuple(c for c in out)
+    return _jit_tree_reduce_g2(xs[None], ys[None], zs[None])
 
 
 @jax.jit

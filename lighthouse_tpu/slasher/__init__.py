@@ -52,6 +52,9 @@ class Slasher:
         self._block_queue: list = []
         self.attester_slashings: list = []
         self.proposer_slashings: list = []
+        # dedup: re-seen conflicting messages must not re-emit the same
+        # slashing into the pool
+        self._emitted: set = set()
 
     # -- ingestion (slasher service feed) -------------------------------------
 
@@ -90,8 +93,11 @@ class Slasher:
             prev = records.get(t2)
             if prev is not None:
                 if prev.data_root != root2:
-                    self._emit_attester_slashing(prev.indexed, indexed)
-                    found += 1
+                    key = (vi, t2, prev.data_root, root2)
+                    if key not in self._emitted:
+                        self._emitted.add(key)
+                        self._emit_attester_slashing(prev.indexed, indexed)
+                        found += 1
                 continue  # same vote (or slashing emitted); nothing to record
             # surround checks against every recorded vote in the window.
             # attestation_1 must SURROUND attestation_2
@@ -148,6 +154,7 @@ class Slasher:
 
     def _prune(self, current_epoch: int):
         floor = max(0, current_epoch - self.config.history_length)
+        self._emitted = {k for k in self._emitted if k[1] >= floor}
         slot_floor = floor * self.E.SLOTS_PER_EPOCH
         for vi in list(self._atts):
             recs = self._atts[vi]

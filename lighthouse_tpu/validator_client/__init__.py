@@ -162,11 +162,17 @@ class DutiesService:
     def attester_duties(self, epoch: int) -> list[Duty]:
         from ..state_processing.accessors import compute_start_slot_at_epoch
 
-        state = self.node.head_state()
-        key = (epoch, getattr(self.node, "head_root", lambda: None)())
+        # cache key BEFORE any state fetch: head_state() over HTTP pulls the
+        # whole SSZ state — exactly the cost the cache exists to avoid.
+        # Keyed by epoch: committee shuffling is seeded lookahead epochs
+        # back, so within an epoch the assignment is head-independent
+        # (cross-epoch reorgs would need dependent-root tracking — the
+        # reference's duties_service reorg hook).
+        key = epoch
         cached = self._duty_cache.get(key)
         if cached is not None:
             return cached
+        state = self.node.head_state()
         ours = self._our_indices(state)
         cc = committee_cache_at(state, epoch, self.E)
         start = compute_start_slot_at_epoch(epoch, self.E)
@@ -328,11 +334,11 @@ class ValidatorClient:
     """ProductionValidatorClient analog: wires the services and drives them
     per slot (lib.rs:91-98)."""
 
-    def __init__(self, chain, keypairs, spec, E, slashing_db=None):
-        self.chain = chain
+    def __init__(self, chain, keypairs, spec, E, slashing_db=None, node=None):
+        self.chain = chain  # None when running over a remote node interface
         self.spec = spec
         self.E = E
-        self.node = LocalBeaconNode(chain)
+        self.node = node if node is not None else LocalBeaconNode(chain)
         self.store = ValidatorStore(slashing_db)
         for kp in keypairs:
             self.store.add_validator(kp.pk.to_bytes(), LocalKeystoreSigner(kp.sk))
@@ -351,6 +357,6 @@ class ValidatorClient:
         if not self.doppelganger.signing_enabled(epoch):
             return None
         root = self.block_service.propose_if_due(slot)
-        head = self.chain.head_root
+        head = self.node.head_root()
         self.attestation_service.attest(slot, head)
         return root

@@ -1,0 +1,136 @@
+"""Node assembly + API client + network config + validator manager.
+
+ClientBuilder wires store→chain→network→http→VC→timer (builder.rs:109-787
+analog); the eth2 HTTP client drives a VC over the wire; config.yaml
+round-trips into ChainSpec; validator-manager creates/imports keystores."""
+
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.client import ClientBuilder, ClientConfig
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+
+def _cfg(**kw):
+    bls.set_backend("fake_crypto")
+    base = dict(
+        spec=replace(minimal_spec(), altair_fork_epoch=0),
+        E=E,
+        validator_count=16,
+        validate=True,
+        manual_slot_clock=True,
+    )
+    base.update(kw)
+    return ClientConfig(**base)
+
+
+def test_client_builder_full_node_reaches_finality():
+    client = ClientBuilder(_cfg()).build().start()
+    try:
+        for slot in range(1, 4 * E.SLOTS_PER_EPOCH + 1):
+            client.on_slot(slot)
+        assert client.chain.head_state.slot == 4 * E.SLOTS_PER_EPOCH
+        assert client.chain.finalized_checkpoint.epoch >= 2
+        assert client.http_server is not None and client.network is not None
+    finally:
+        client.stop()
+
+
+def test_two_clients_sync_via_network():
+    a = ClientBuilder(_cfg()).build().start()
+    b = ClientBuilder(_cfg(validate=False)).build().start()
+    try:
+        for slot in range(1, 9):
+            a.on_slot(slot)
+        b.slot_clock.set_slot(8)
+        peer = b.network.connect("127.0.0.1", a.network.port)
+        imported = b.network.sync.sync_with(peer)
+        assert imported == 8
+        assert b.chain.head_root == a.chain.head_root
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_vc_over_http_client():
+    """A validator client running over the REAL HTTP transport proposes a
+    block on the node (eth2 client + HttpBeaconNode path)."""
+    from lighthouse_tpu.eth2 import BeaconNodeHttpClient, HttpBeaconNode
+    from lighthouse_tpu.types.containers import build_types
+    from lighthouse_tpu.validator_client import ValidatorClient
+
+    node_client = ClientBuilder(_cfg(validate=False)).build().start()
+    try:
+        api = BeaconNodeHttpClient(
+            f"http://127.0.0.1:{node_client.http_server.port}"
+        )
+        assert api.get_health()
+        genesis = api.get_genesis()
+        assert genesis["genesis_validators_root"].startswith("0x")
+
+        remote = HttpBeaconNode(api, build_types(E))
+        vc = ValidatorClient(
+            None, node_client.keypairs, node_client.chain.spec, E, node=remote
+        )
+        node_client.slot_clock.set_slot(1)
+        root = vc.on_slot(1)
+        assert root is not None
+        assert node_client.chain.head_state.slot == 1
+        assert node_client.chain.head_root == root
+    finally:
+        node_client.stop()
+
+
+def test_network_config_yaml_roundtrip():
+    from lighthouse_tpu.types.network_config import (
+        Eth2NetworkConfig,
+        built_in_network,
+    )
+
+    net = built_in_network("minimal-dev")
+    text = net.to_config_yaml()
+    assert "PRESET_BASE" in text and "ALTAIR_FORK_EPOCH" in text
+    back = Eth2NetworkConfig.from_config_yaml(text, name="roundtrip")
+    assert back.spec.altair_fork_epoch == 0
+    assert back.spec.seconds_per_slot == net.spec.seconds_per_slot
+    assert back.E is net.E
+
+    main = built_in_network("mainnet")
+    assert main.spec.altair_fork_epoch == 74240
+    # disabled fork serializes as FAR_FUTURE and loads back as None
+    assert "18446744073709551615" in main.to_config_yaml()
+    back_main = Eth2NetworkConfig.from_config_yaml(main.to_config_yaml())
+    assert back_main.spec.electra_fork_epoch is None
+
+
+def test_validator_manager_create_list_import(tmp_path):
+    from lighthouse_tpu import validator_manager as VM
+
+    seed = b"\x07" * 32
+    records = VM.create_validators(
+        seed,
+        2,
+        tmp_path / "v1",
+        "pw",
+        spec=minimal_spec(),
+        E=E,
+        fast_kdf=True,
+    )
+    assert len(records) == 2
+    assert records[0]["deposit_data_root"]
+    listed = VM.list_validators(tmp_path / "v1")
+    assert len(listed) == 2
+    assert listed[0]["path"].startswith("m/12381/3600/")
+
+    ks_file = next((tmp_path / "v1").glob("keystore-*.json"))
+    pk = VM.import_keystore(ks_file, "pw", tmp_path / "v2")
+    assert VM.list_validators(tmp_path / "v2")[0]["pubkey"] == pk.hex()
+    with pytest.raises(Exception):
+        VM.import_keystore(ks_file, "wrong", tmp_path / "v3")
+
+    signers = VM.load_signers(tmp_path / "v1", "pw")
+    assert len(signers) == 2
+    assert signers[0][1].public_key().to_bytes() == signers[0][0]
