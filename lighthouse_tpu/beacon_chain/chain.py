@@ -118,6 +118,51 @@ class BeaconChain:
         self.fork_choice.state_provider = self._justified_state_provider
         store.put_state(genesis_state.hash_tree_root(), genesis_state)
 
+    @classmethod
+    def from_checkpoint(
+        cls,
+        store: HotColdDB,
+        anchor_state,
+        anchor_block,
+        spec: ChainSpec,
+        E,
+        slot_clock: SlotClock,
+        wss_checkpoint: bytes | None = None,
+        **kwargs,
+    ) -> "BeaconChain":
+        """Checkpoint (weak-subjectivity) start: anchor on a finalized
+        state+block instead of genesis (ClientGenesis::WeakSubjSszBytes,
+        beacon_node/src/config.rs:510-561). History before the anchor
+        arrives later via backfill sync. `wss_checkpoint` pins the expected
+        anchor block root (--wss-checkpoint verification)."""
+        anchor_root = anchor_block.message.hash_tree_root()
+        if wss_checkpoint is not None and anchor_root != wss_checkpoint:
+            raise BeaconChainError(
+                f"checkpoint mismatch: anchor {anchor_root.hex()} != "
+                f"trusted {wss_checkpoint.hex()}"
+            )
+        if anchor_block.message.state_root != anchor_state.hash_tree_root():
+            raise BeaconChainError("anchor block does not commit to anchor state")
+        chain = cls(
+            store=store,
+            genesis_state=anchor_state,
+            spec=spec,
+            E=E,
+            slot_clock=slot_clock,
+            **kwargs,
+        )
+        chain._blocks_by_root[anchor_root] = anchor_block
+        store.put_block(anchor_root, anchor_block)
+        return chain
+
+    @property
+    def anchor_slot(self) -> int:
+        """Slot of the chain's anchor (0 for genesis starts)."""
+        anchor = self._blocks_by_root.get(self.genesis_block_root)
+        if anchor is None:
+            return 0
+        return anchor.message.slot
+
     # ------------------------------------------------------------------ head
 
     @property

@@ -65,6 +65,13 @@ class DataAvailabilityChecker:
                     raise AvailabilityCheckError(
                         "sidecar header does not root to this block"
                     )
+                if getattr(sc, "kzg_commitment_inclusion_proof", None):
+                    from ..ssz.merkle_proof import verify_blob_inclusion_proof
+
+                    if not verify_blob_inclusion_proof(sc, self.E):
+                        raise AvailabilityCheckError(
+                            f"blob {sc.index}: invalid commitment inclusion proof"
+                        )
             blobs.append(bytes(sc.blob))
             commitments.append(bytes(sc.kzg_commitment))
             proofs.append(bytes(sc.kzg_proof))

@@ -98,10 +98,17 @@ class ClientBuilder:
         else:
             store = HotColdDB(MemoryStore())
         # genesis
-        c.keypairs = bls.interop_keypairs(cfg.validator_count)
         if cfg.genesis_state is not None:
+            # provided (checkpoint-style) state: interop keys would not
+            # match its registry — signers must be wired explicitly
+            if cfg.validate:
+                raise ValueError(
+                    "validate=True with a provided genesis_state: wire a "
+                    "ValidatorClient with that network's keys instead"
+                )
             genesis_state = cfg.genesis_state
         else:
+            c.keypairs = bls.interop_keypairs(cfg.validator_count)
             genesis_state = interop_genesis_state(
                 c.keypairs, cfg.genesis_time, b"\x42" * 32, cfg.spec, cfg.E
             )

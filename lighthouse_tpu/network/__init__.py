@@ -42,6 +42,7 @@ SCORE_INVALID_MESSAGE = -10.0
 SCORE_TIMELY_MESSAGE = 0.5
 BAN_THRESHOLD = -40.0
 MAX_SCORE = 100.0
+_GOSSIP_IO_TIMEOUT = 30.0  # bounds send stalls AND idle reader probes
 
 
 @dataclass
@@ -169,6 +170,57 @@ class SyncManager:
     def __init__(self, service: "NetworkService"):
         self.service = service
 
+    def backfill(self, peer: Peer, verify_signatures: bool = True) -> int:
+        """Backfill sync (sync/backfill_sync/mod.rs:1-9): after a
+        checkpoint start, pull history BACKWARD from the anchor, verifying
+        the hash chain (and proposer signatures in one batch against the
+        anchor registry — it is append-only, so every historic proposer is
+        in it). Blocks land in the store without state transition."""
+        chain = self.service.chain
+        anchor_root = chain.genesis_block_root
+        anchor = chain._blocks_by_root.get(anchor_root) or chain.store.get_block(
+            anchor_root
+        )
+        if anchor is None or anchor.message.slot == 0:
+            return 0
+        expected_root = bytes(anchor.message.parent_root)
+        oldest_slot = int(anchor.message.slot)
+        stored = 0
+        batch = self.EPOCHS_PER_BATCH * chain.E.SLOTS_PER_EPOCH
+        while oldest_slot > 0:
+            start = max(0, oldest_slot - batch)
+            blocks = peer.client.blocks_by_range(
+                start, oldest_slot - start, self.service.decode_block
+            )
+            if not blocks:
+                break
+            # walk backward collecting the chain-linked subset, then verify
+            # the whole batch's proposer signatures in ONE RLC batch before
+            # any of it is stored
+            linked = []
+            for signed in reversed(blocks):
+                root = signed.message.hash_tree_root()
+                if root != expected_root:
+                    continue  # not on our chain (peer included forks)
+                linked.append((root, signed))
+                expected_root = bytes(signed.message.parent_root)
+            if not linked:
+                break
+            if verify_signatures and not _verify_backfill_signatures(
+                [s for _, s in linked], chain
+            ):
+                self.service.peers.report(peer.peer_id, SCORE_INVALID_MESSAGE)
+                return stored
+            for root, signed in linked:
+                # store only: backfilled history is cold data, served from
+                # the store (pinning it in the hot block map would never be
+                # pruned for pre-anchor slots)
+                chain.store.put_block(root, signed)
+                oldest_slot = int(signed.message.slot)
+                stored += 1
+        inc_counter("backfill_blocks_stored_total", amount=stored)
+        return stored
+
     def sync_with(self, peer: Peer) -> int:
         chain = self.service.chain
         status = peer.client.status(self.service.local_status())
@@ -191,6 +243,36 @@ class SyncManager:
             if result.imported == 0:
                 break
         return imported_total
+
+
+def _verify_backfill_signatures(blocks, chain) -> bool:
+    """One RLC batch over backfilled proposer signatures. The anchor
+    state's registry is append-only, so every historic proposer index
+    resolves in it; domains come from the fork schedule, not a state."""
+    from ..crypto import bls
+    from ..types.chain_spec import Domain, compute_signing_root
+
+    state = chain.head_state
+    spec = chain.spec
+    sets = []
+    for signed in blocks:
+        m = signed.message
+        if m.proposer_index >= len(state.validators):
+            return False
+        pubkey = bls.PublicKey(bytes(state.validators[m.proposer_index].pubkey))
+        epoch = m.slot // chain.E.SLOTS_PER_EPOCH
+        domain = spec.compute_domain_from_parts(
+            Domain.BEACON_PROPOSER,
+            spec.fork_version_at_epoch(epoch),
+            bytes(state.genesis_validators_root),
+        )
+        root = compute_signing_root(m.hash_tree_root(), domain)
+        sets.append(
+            bls.SignatureSet.single(
+                bls.Signature(bytes(signed.signature)), pubkey, root
+            )
+        )
+    return bls.get_backend().verify_signature_sets(sets)
 
 
 class NetworkService:
@@ -262,9 +344,9 @@ class NetworkService:
             raise RpcError("peer on a different fork digest")
         peer = Peer(host=host, port=port, client=client, status=status)
         peer.gossip_sock = socket.create_connection((host, port), timeout=10)
-        # persistent stream: clear the connect timeout or an idle 10s kills
-        # the reader with TimeoutError and the peer silently goes deaf
-        peer.gossip_sock.settimeout(None)
+        # bounded I/O: a stalled remote must not wedge publish (sendall
+        # holds peer.lock); the reader probes idle timeouts harmlessly
+        peer.gossip_sock.settimeout(_GOSSIP_IO_TIMEOUT)
         _send_protocol(peer.gossip_sock, M.PROTO_GOSSIP)
         # announce our listening port so the peer can identify us
         _send_block(peer.gossip_sock, self.port.to_bytes(4, "little"))
@@ -305,9 +387,20 @@ class NetworkService:
         self._gossip_reader(sock, peer.peer_id)
 
     def _gossip_reader(self, sock, peer_id: str):
+        sock.settimeout(_GOSSIP_IO_TIMEOUT)
         while not self._stopping:
+            # idle-safe probe: a timeout BEFORE a frame starts just retries;
+            # a timeout mid-frame (stalled sender) is a real failure
             try:
-                framed = _recv_block(sock)
+                first = sock.recv(1)
+            except TimeoutError:
+                continue
+            except OSError:
+                break
+            if not first:
+                break
+            try:
+                framed = _recv_block(sock, first_byte=first)
             except (RpcError, OSError):
                 break
             try:

@@ -47,11 +47,15 @@ def _write_varint(n: int) -> bytes:
             return bytes(out)
 
 
-def _read_varint(sock) -> int:
+def _read_varint(sock, first_byte: bytes | None = None) -> int:
     out = 0
     shift = 0
     while True:
-        b = _read_exact(sock, 1)[0]
+        if first_byte is not None:
+            b = first_byte[0]
+            first_byte = None
+        else:
+            b = _read_exact(sock, 1)[0]
         out |= (b & 0x7F) << shift
         if not b & 0x80:
             return out
@@ -80,8 +84,8 @@ def _send_block(sock, data: bytes):
     sock.sendall(_write_varint(len(data)) + struct.pack("<I", len(comp)) + comp)
 
 
-def _recv_block(sock) -> bytes:
-    expected = _read_varint(sock)
+def _recv_block(sock, first_byte: bytes | None = None) -> bytes:
+    expected = _read_varint(sock, first_byte)
     if expected > MAX_PAYLOAD:
         raise RpcError("payload too large")
     comp_len = struct.unpack("<I", _read_exact(sock, 4))[0]

@@ -9,9 +9,24 @@ import json
 import os
 import pathlib
 
+import contextlib
+
 from .crypto import bls
 from .crypto.key_derivation import derive_sk_from_path, validator_keypair_path
 from .crypto.keystore import Keystore
+
+
+@contextlib.contextmanager
+def _host_backend():
+    """Key management needs real curve ops; restore the caller's backend
+    after (mutating the process-global backend out from under a running
+    chain breaks its verification)."""
+    prev = bls.backend_name()
+    bls.set_backend("host")
+    try:
+        yield
+    finally:
+        bls.set_backend(prev)
 
 
 def create_validators(
@@ -29,8 +44,17 @@ def create_validators(
     deposit_data.json; returns the deposit-data records."""
     out = pathlib.Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
-    bls.set_backend("host")
     records = []
+    with _host_backend():
+        _create_all(seed, count, out, password, first_index, amount_gwei,
+                    spec, E, fast_kdf, records)
+    with open(out / "deposit_data.json", "w") as f:
+        json.dump(records, f, indent=2)
+    return records
+
+
+def _create_all(seed, count, out, password, first_index, amount_gwei, spec, E,
+                fast_kdf, records):
     for i in range(first_index, first_index + count):
         path = validator_keypair_path(i, "signing")
         sk_int = derive_sk_from_path(seed, path)
@@ -61,9 +85,6 @@ def create_validators(
             record["signature"] = bytes(data.signature).hex()
             record["deposit_data_root"] = data.hash_tree_root().hex()
         records.append(record)
-    with open(out / "deposit_data.json", "w") as f:
-        json.dump(records, f, indent=2)
-    return records
 
 
 def list_validators(dir_path: str | os.PathLike) -> list[dict]:
@@ -92,10 +113,10 @@ def import_keystore(
 def load_signers(dir_path: str | os.PathLike, password: str):
     """Decrypt every keystore in a directory into (pubkey, SecretKey)
     pairs — what a VC start-up does."""
-    bls.set_backend("host")
     out = []
-    for p in sorted(pathlib.Path(dir_path).glob("keystore-*.json")):
-        ks = Keystore.load(p)
-        secret = ks.decrypt(password)
-        out.append((ks.pubkey, bls.SecretKey.from_bytes(secret)))
+    with _host_backend():
+        for p in sorted(pathlib.Path(dir_path).glob("keystore-*.json")):
+            ks = Keystore.load(p)
+            secret = ks.decrypt(password)
+            out.append((ks.pubkey, bls.SecretKey.from_bytes(secret)))
     return out

@@ -1,0 +1,100 @@
+"""Eth1 deposit follower + eth1 genesis service (beacon_node/eth1)."""
+
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.eth1 import (
+    DepositCacheError,
+    DepositLog,
+    Eth1GenesisService,
+    Eth1Service,
+    MockEth1Provider,
+)
+from lighthouse_tpu.state_processing.genesis import build_deposit_data
+from lighthouse_tpu.state_processing.per_block import process_deposit
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+
+@pytest.fixture()
+def rig():
+    bls.set_backend("fake_crypto")
+    spec = replace(
+        minimal_spec(),
+        min_genesis_active_validator_count=4,
+        min_genesis_time=1_500_000_000,
+        genesis_delay=60,
+        eth1_follow_distance=2,
+    )
+    provider = MockEth1Provider(spec)
+    service = Eth1Service(provider, spec, E)
+    kps = bls.interop_keypairs(8)
+    return spec, provider, service, kps
+
+
+def test_deposit_cache_contiguity_and_proofs(rig):
+    spec, provider, service, kps = rig
+    datas = [build_deposit_data(kp, 32_000_000_000, spec, E) for kp in kps[:4]]
+    for d in datas:
+        provider.submit_deposit(d)
+    provider.mine_block()
+    service.update()
+    assert len(service.deposit_cache.logs) == 4
+
+    # non-contiguous insert refused
+    with pytest.raises(DepositCacheError):
+        service.deposit_cache.insert_log(
+            DepositLog(index=9, deposit_data=datas[0], block_number=1)
+        )
+
+    # the proofs verify through real deposit processing
+    from lighthouse_tpu.state_processing import interop_genesis_state
+
+    state = interop_genesis_state(kps[4:8], 1_600_000_000, b"\x42" * 32, spec, E)
+    deposits = service.deposit_cache.get_deposits(0, 2, 4)
+    state.eth1_data.deposit_root = service.deposit_cache.deposit_root(4)
+    state.eth1_data.deposit_count = 4
+    state.eth1_deposit_index = 0
+    n0 = len(state.validators)
+    for dep in deposits:
+        process_deposit(state, dep, spec, E)
+    assert len(state.validators) == n0 + 2
+
+
+def test_eth1_vote_follows_distance(rig):
+    spec, provider, service, kps = rig
+    for d in (build_deposit_data(kp, 32_000_000_000, spec, E) for kp in kps[:4]):
+        provider.submit_deposit(d)
+    for _ in range(10):
+        provider.mine_block()
+    service.update()
+
+    from lighthouse_tpu.state_processing import interop_genesis_state
+
+    state = interop_genesis_state(kps[:4], 2_000_000_000, b"\x42" * 32, spec, E)
+    vote = service.eth1_data_for_voting(state)
+    # candidate must be behind the follow distance and carry the cache root
+    assert vote.deposit_count == 4
+    assert vote.deposit_root == service.deposit_cache.deposit_root(4)
+
+    # no eligible candidate → default vote (current eth1_data)
+    empty = Eth1Service(MockEth1Provider(spec), spec, E)
+    assert empty.eth1_data_for_voting(state) == state.eth1_data
+
+
+def test_eth1_genesis_service_builds_valid_genesis(rig):
+    spec, provider, service, kps = rig
+    gs = Eth1GenesisService(service, spec, E)
+    assert gs.try_genesis() is None  # no deposits yet
+    for kp in kps[:4]:
+        provider.submit_deposit(build_deposit_data(kp, 32_000_000_000, spec, E))
+    provider.mine_block()
+    state = gs.try_genesis()
+    assert state is not None
+    assert len(state.validators) == 4
+    assert state.genesis_time == provider._blocks[-1].timestamp + spec.genesis_delay
+    from lighthouse_tpu.state_processing.genesis import is_valid_genesis_state
+
+    assert is_valid_genesis_state(state, spec, E)

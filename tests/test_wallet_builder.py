@@ -1,0 +1,70 @@
+"""EIP-2386 wallets + the builder (MEV relay) client seam."""
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.key_derivation import derive_sk_from_path
+from lighthouse_tpu.crypto.wallet import Wallet, WalletError
+from lighthouse_tpu.execution_layer import MockExecutionLayer
+from lighthouse_tpu.execution_layer.builder_client import (
+    MockBuilder,
+    ValidatorRegistration,
+)
+from lighthouse_tpu.types.containers import build_types
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+
+def test_wallet_roundtrip_and_account_derivation():
+    bls.set_backend("host")
+    seed = b"\x21" * 32
+    w = Wallet.create("w1", "wallet-pw", seed=seed, _fast_kdf=True)
+    assert w.nextaccount == 0
+
+    ks0 = w.next_validator("wallet-pw", "ks-pw", _fast_kdf=True)
+    ks1 = w.next_validator("wallet-pw", "ks-pw", _fast_kdf=True)
+    assert w.nextaccount == 2
+    assert ks0.path == "m/12381/3600/0/0/0"
+    assert ks1.path == "m/12381/3600/1/0/0"
+    # keystore secrets match direct EIP-2334 derivation
+    assert int.from_bytes(ks0.decrypt("ks-pw"), "big") == derive_sk_from_path(
+        seed, "m/12381/3600/0/0/0"
+    )
+
+    back = Wallet.from_json(w.to_json())
+    assert back.decrypt_seed("wallet-pw") == seed
+    with pytest.raises(Exception):
+        back.decrypt_seed("wrong")
+    with pytest.raises(WalletError):
+        Wallet({"type": "nd"})
+
+
+def test_mock_builder_bid_and_unblind():
+    t = build_types(E)
+    el = MockExecutionLayer(t, E)
+    builder = MockBuilder(el, t, E)
+    pubkey = b"\xaa" * 48
+
+    # unregistered validators get no bid
+    assert builder.get_header(1, None, pubkey) is None
+    builder.register_validators([ValidatorRegistration(pubkey=pubkey)])
+    bid = builder.get_header(1, None, pubkey)
+    assert bid is not None and bid.value_wei > 0
+    assert bid.header.block_hash != b"\x00" * 32
+
+    # a blinded block round-trips to the full payload
+    class _Blinded:
+        pass
+
+    blinded = _Blinded()
+    blinded.message = _Blinded()
+    blinded.message.body = _Blinded()
+    blinded.message.body.execution_payload_header = bid.header
+    payload = builder.submit_blinded_block(blinded)
+    assert bytes(payload.block_hash) == bytes(bid.header.block_hash)
+    assert payload.hash_tree_root() is not None
+    with pytest.raises(RuntimeError):
+        bad = _Blinded()
+        bad.message = _Blinded()
+        bad.message.body = _Blinded()
+        bad.message.body.execution_payload_header = t.ExecutionPayloadHeaderCapella()
+        builder.submit_blinded_block(bad)
