@@ -37,8 +37,10 @@ class Wallet:
             seed = os.urandom(32)
         if len(seed) < 32:
             raise WalletError("seed must be >= 32 bytes")
-        # the wallet's crypto section is an EIP-2335 keystore over the seed
-        ks = Keystore.encrypt(seed, password, _fast_kdf=_fast_kdf)
+        # the wallet's crypto section reuses the EIP-2335 crypto over the
+        # seed (any length ≥ 32 — e.g. 64-byte BIP39 seeds); no pubkey is
+        # derivable from a seed, so an empty one is recorded
+        ks = Keystore.encrypt(seed, password, pubkey=b"", _fast_kdf=_fast_kdf)
         doc = {
             "crypto": ks.doc["crypto"],
             "name": name,

@@ -138,27 +138,53 @@ class Eth1Service:
 
     # -- eth1 data voting (spec get_eth1_vote) --------------------------------
 
+    def _candidate_blocks(self, period_start: int) -> list[Eth1Block]:
+        """Blocks inside the spec candidate window: FOLLOW_DISTANCE to
+        2×FOLLOW_DISTANCE eth1-blocks behind the period start."""
+        spec = self.spec
+        dist = spec.eth1_follow_distance * spec.seconds_per_eth1_block
+        return [
+            b
+            for b in self.block_cache.blocks
+            if b.timestamp + dist <= period_start
+            and b.timestamp + 2 * dist >= period_start
+        ]
+
     def eth1_data_for_voting(self, state) -> object:
+        """Spec get_eth1_vote: tally the period's existing votes over the
+        candidate-window blocks; majority wins, latest candidate breaks
+        ties/absence, current eth1_data when no candidate qualifies."""
         from ..types.containers import build_types
 
         t = build_types(self.E)
-        spec = self.spec
-        period_start = _voting_period_start_time(state, spec, self.E)
-        lookahead = (
-            spec.eth1_follow_distance * spec.seconds_per_eth1_block
-        )
-        candidate = self.block_cache.block_by_timestamp(period_start - lookahead)
-        if (
-            candidate is None
-            or candidate.deposit_count < state.eth1_data.deposit_count
-            or candidate.deposit_count > len(self.deposit_cache.logs)
-        ):
+        period_start = _voting_period_start_time(state, self.spec, self.E)
+        votes_to_consider = []
+        for b in self._candidate_blocks(period_start):
+            if (
+                b.deposit_count >= state.eth1_data.deposit_count
+                and b.deposit_count <= len(self.deposit_cache.logs)
+            ):
+                votes_to_consider.append(
+                    t.Eth1Data(
+                        deposit_root=self.deposit_cache.deposit_root(
+                            b.deposit_count
+                        ),
+                        deposit_count=b.deposit_count,
+                        block_hash=b.block_hash,
+                    )
+                )
+        if not votes_to_consider:
             return state.eth1_data  # default vote (spec behavior)
-        return t.Eth1Data(
-            deposit_root=self.deposit_cache.deposit_root(candidate.deposit_count),
-            deposit_count=candidate.deposit_count,
-            block_hash=candidate.block_hash,
-        )
+        valid_votes = [
+            v for v in state.eth1_data_votes if v in votes_to_consider
+        ]
+        if valid_votes:
+            best = max(
+                valid_votes,
+                key=lambda v: (valid_votes.count(v), -valid_votes.index(v)),
+            )
+            return best
+        return votes_to_consider[-1]  # latest candidate
 
     def deposits_for_block(self, state) -> list:
         """Deposits the next block must include (eth1_deposit_index →

@@ -82,9 +82,6 @@ def compute_blob_inclusion_proof(body, index: int, E) -> list[bytes]:
     """Branch proving body.blob_kzg_commitments[index] against the body
     root: list-element branch, then the length mixin, then the body-field
     branch — matching the sidecar's fixed-depth proof vector."""
-    from ..types.containers import build_types
-
-    t = build_types(E)
     cls = type(body)
     commitments = list(body.blob_kzg_commitments)
     limit = E.MAX_BLOB_COMMITMENTS_PER_BLOCK
@@ -98,15 +95,13 @@ def compute_blob_inclusion_proof(body, index: int, E) -> list[bytes]:
     return elem_branch + [length_leaf] + field_branch
 
 
-def blob_inclusion_gindex(index: int, body, E) -> int:
-    """The proof's leaf index within the composed tree (element index,
-    then bit 0 for the data side of the length mixin, then the field
-    index)."""
-    cls = type(body)
-    field_index = list(cls._fields).index("blob_kzg_commitments")
+def blob_inclusion_index(index: int, body_cls, E) -> int:
+    """The proof's leaf index within the composed tree: [element bits]
+    [mixin bit = 0][body-field bits] — shared by producer and verifier so
+    the encodings cannot drift."""
+    field_index = list(body_cls._fields).index("blob_kzg_commitments")
     list_d = _list_depth(E.MAX_BLOB_COMMITMENTS_PER_BLOCK)
-    # [element bits][mixin bit=0][field bits]
-    return index | (0 << list_d) | (field_index << (list_d + 1))
+    return index | (field_index << (list_d + 1))
 
 
 def verify_blob_inclusion_proof(sidecar, E) -> bool:
@@ -122,12 +117,9 @@ def verify_blob_inclusion_proof(sidecar, E) -> bool:
     depth = E.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH
     if len(branch) != depth:
         return False
-    # reconstruct the gindex path: element index | mixin 0 | field index.
-    field_index = list(t.BeaconBlockBodyDeneb._fields).index(
-        "blob_kzg_commitments"
+    index = blob_inclusion_index(
+        int(sidecar.index), t.BeaconBlockBodyDeneb, E
     )
-    list_d = _list_depth(E.MAX_BLOB_COMMITMENTS_PER_BLOCK)
-    index = int(sidecar.index) | (field_index << (list_d + 1))
     return verify_merkle_proof(leaf, branch, depth, index, body_root)
 
 

@@ -1,0 +1,138 @@
+"""Off-node analytics service (the `watch` crate analog).
+
+An updater polls a beacon node over the HTTP API and records canonical
+slots, proposers, and finality progress into sqlite (the reference uses
+postgres/diesel); query helpers compute the per-proposer block counts,
+missed-slot lists, and participation the reference's REST server exposes
+(watch/src/{updater,database,server})."""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+
+from ..eth2 import BeaconNodeHttpClient
+
+
+class WatchDB:
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS canonical_slots ("
+            "slot INTEGER PRIMARY KEY, root BLOB, proposer INTEGER, "
+            "skipped INTEGER NOT NULL DEFAULT 0)"
+        )
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS finality ("
+            "checked_at_slot INTEGER PRIMARY KEY, "
+            "justified_epoch INTEGER, finalized_epoch INTEGER)"
+        )
+        self._conn.commit()
+
+    def record_slot(self, slot: int, root: bytes | None, proposer: int | None):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO canonical_slots VALUES (?, ?, ?, ?)",
+                (slot, root, proposer, 1 if root is None else 0),
+            )
+            self._conn.commit()
+
+    def record_finality(self, at_slot: int, justified: int, finalized: int):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO finality VALUES (?, ?, ?)",
+                (at_slot, justified, finalized),
+            )
+            self._conn.commit()
+
+    # -- queries (server.rs routes) -------------------------------------------
+
+    def proposer_counts(self) -> dict[int, int]:
+        rows = self._conn.execute(
+            "SELECT proposer, COUNT(*) FROM canonical_slots "
+            "WHERE skipped = 0 GROUP BY proposer"
+        ).fetchall()
+        return {p: c for p, c in rows}
+
+    def missed_slots(self) -> list[int]:
+        return [
+            r[0]
+            for r in self._conn.execute(
+                "SELECT slot FROM canonical_slots WHERE skipped = 1 ORDER BY slot"
+            )
+        ]
+
+    def latest_finality(self) -> tuple[int, int] | None:
+        row = self._conn.execute(
+            "SELECT justified_epoch, finalized_epoch FROM finality "
+            "ORDER BY checked_at_slot DESC LIMIT 1"
+        ).fetchone()
+        return row
+
+    def highest_slot(self) -> int:
+        row = self._conn.execute(
+            "SELECT MAX(slot) FROM canonical_slots"
+        ).fetchone()
+        return row[0] if row[0] is not None else -1
+
+
+class WatchUpdater:
+    """Polls the node and fills the DB (updater.rs)."""
+
+    def __init__(self, client: BeaconNodeHttpClient, db: WatchDB, types):
+        self.client = client
+        self.db = db
+        self.types = types
+
+    def update(self) -> int:
+        """Walk new canonical slots up to the node's head; returns how many
+        slots were recorded."""
+        syncing = self.client.get_syncing()
+        head_slot = int(syncing["head_slot"])
+        # slot 0 is genesis, not a proposal
+        start = max(self.db.highest_slot() + 1, 1)
+        if start > head_slot:
+            return 0
+        # walk the canonical chain backward from head to `start`
+        blocks_by_slot: dict[int, tuple] = {}
+        data = self.client.get_block_ssz("head")
+        signed = self.types.decode_by_fork("SignedBeaconBlock", data)
+        walk_complete = False
+        while True:
+            slot = int(signed.message.slot)
+            blocks_by_slot[slot] = (
+                signed.message.hash_tree_root(),
+                int(signed.message.proposer_index),
+            )
+            parent = bytes(signed.message.parent_root)
+            if slot <= max(start, 1) or parent == b"\x00" * 32:
+                walk_complete = True
+                break
+            try:
+                data = self.client.get_block_ssz("0x" + parent.hex())
+            except Exception:  # noqa: BLE001 — history beyond the hot cache
+                break
+            signed = self.types.decode_by_fork("SignedBeaconBlock", data)
+
+        # A slot with no block is only PROVABLY skipped when the walk
+        # reached below it — an incomplete walk must leave a hole, never
+        # record real proposals as missed (rows are write-once).
+        certainty_floor = start if walk_complete else min(blocks_by_slot)
+        recorded = 0
+        for slot in range(start, head_slot + 1):
+            ent = blocks_by_slot.get(slot)
+            if ent is not None:
+                self.db.record_slot(slot, ent[0], ent[1])
+            elif slot >= certainty_floor:
+                self.db.record_slot(slot, None, None)  # skipped slot
+            else:
+                continue  # hole: history unavailable, leave unrecorded
+            recorded += 1
+        fin = self.client.get_finality_checkpoints("head")
+        self.db.record_finality(
+            head_slot,
+            int(fin["current_justified"]["epoch"]),
+            int(fin["finalized"]["epoch"]),
+        )
+        return recorded

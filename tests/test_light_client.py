@@ -1,0 +1,236 @@
+"""Sync-committee light client: bootstrap, updates, store advancement.
+
+A harness chain produces real states; the light client bootstraps from a
+trusted root and follows finality using only headers + branches + sync
+aggregates (consensus/types light_client_* + altair light-client spec)."""
+
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.light_client import (
+    LightClientError,
+    create_bootstrap,
+    create_update,
+    initialize_light_client_store,
+    process_light_client_update,
+)
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+
+@pytest.fixture(scope="module")
+def chain():
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, E, validator_count=16)
+    h.extend_chain(5 * E.SLOTS_PER_EPOCH)
+    assert h.finalized_epoch >= 2
+    return h
+
+
+def test_bootstrap_roundtrip(chain):
+    h = chain
+    state = h.chain.head_state.copy()
+    boot = create_bootstrap(state, E)
+    trusted = boot.header.beacon.hash_tree_root()
+    store = initialize_light_client_store(trusted, boot, E)
+    assert store.finalized_header.beacon.slot == state.slot
+
+    with pytest.raises(LightClientError):
+        initialize_light_client_store(b"\x00" * 32, boot, E)
+
+    # tampered branch refused
+    bad = create_bootstrap(state, E)
+    branch = list(bad.current_sync_committee_branch)
+    branch[2] = b"\x13" * 32
+    bad.current_sync_committee_branch = branch
+    with pytest.raises(LightClientError):
+        initialize_light_client_store(trusted, bad, E)
+
+
+def test_update_advances_finality(chain):
+    h = chain
+    # bootstrap from an early state, then catch up via one update
+    fin_cp = h.chain.finalized_checkpoint
+    fin_state = h.chain._justified_state_provider(fin_cp.root)
+    boot_state = fin_state.copy()
+    boot = create_bootstrap(boot_state, E)
+    store = initialize_light_client_store(
+        boot.header.beacon.hash_tree_root(), boot, E
+    )
+    start_slot = store.finalized_header.beacon.slot
+    # advance the chain so its finality moves past the bootstrap point
+    h.extend_chain(2 * E.SLOTS_PER_EPOCH)
+
+    attested = h.chain.head_state.copy()
+    att_fin_root = attested.finalized_checkpoint.root
+    att_fin_state = h.chain._justified_state_provider(att_fin_root)
+    sync_agg = h.make_sync_aggregate(
+        h.chain.head_state.copy(),
+        h.chain.head_state.slot + 1,
+        h.chain.head_root,
+    )
+    update = create_update(
+        attested,
+        att_fin_state,
+        sync_agg,
+        signature_slot=h.chain.head_state.slot + 1,
+        E=E,
+    )
+    process_light_client_update(
+        store,
+        update,
+        current_slot=h.chain.head_state.slot + 1,
+        spec=h.spec,
+        E=E,
+        genesis_validators_root=h.chain.genesis_validators_root,
+    )
+    assert store.finalized_header.beacon.slot > start_slot
+    assert store.next_sync_committee is not None
+
+    # slot-order violation refused
+    with pytest.raises(LightClientError):
+        process_light_client_update(
+            store, update, current_slot=0, spec=h.spec, E=E,
+            genesis_validators_root=h.chain.genesis_validators_root,
+        )
+
+    # tampered finality branch refused
+    bad = create_update(
+        attested, att_fin_state, sync_agg,
+        signature_slot=h.chain.head_state.slot + 1, E=E,
+    )
+    fb = list(bad.finality_branch)
+    fb[3] = b"\x14" * 32
+    bad.finality_branch = fb
+    with pytest.raises(LightClientError):
+        process_light_client_update(
+            store, bad, current_slot=h.chain.head_state.slot + 1,
+            spec=h.spec, E=E,
+            genesis_validators_root=h.chain.genesis_validators_root,
+        )
+
+
+@pytest.mark.slow
+def test_update_signature_checked_real_crypto(chain):
+    """Under the host backend the sync-aggregate signature must actually
+    verify; a bit-flipped signature is rejected."""
+    h = chain
+    bls.set_backend("host")
+    try:
+        spec = replace(minimal_spec(), altair_fork_epoch=0)
+        hr = BeaconChainHarness(spec, E, validator_count=8)
+        hr.extend_chain(2 * E.SLOTS_PER_EPOCH + 1)
+        boot_state = hr.chain.head_state.copy()
+        boot = create_bootstrap(boot_state, E)
+        store = initialize_light_client_store(
+            boot.header.beacon.hash_tree_root(), boot, E
+        )
+        # produce a real signed sync aggregate over the attested header:
+        # extend one slot so the head block carries a sync aggregate
+        hr.extend_chain(1)
+        head_block = hr.chain.head_block()
+        agg = head_block.message.body.sync_aggregate
+        attested_root = head_block.message.parent_root
+        attested_state = hr.chain._justified_state_provider(attested_root)
+        fin_root = attested_state.finalized_checkpoint.root
+        fin_state = (
+            hr.chain._justified_state_provider(fin_root)
+            if fin_root != b"\x00" * 32
+            else hr.chain._states[hr.chain.genesis_block_root]
+        )
+        update = create_update(
+            attested_state,
+            fin_state,
+            agg,
+            signature_slot=head_block.message.slot,
+            E=E,
+        )
+        process_light_client_update(
+            store.__class__(
+                finalized_header=store.finalized_header,
+                current_sync_committee=attested_state.current_sync_committee,
+            ),
+            update,
+            current_slot=head_block.message.slot,
+            spec=spec,
+            E=E,
+            genesis_validators_root=hr.chain.genesis_validators_root,
+        )
+        # flip a signature bit → rejected
+        bad_sig = bytearray(bytes(agg.sync_committee_signature))
+        bad_sig[10] ^= 1
+        bad_agg = type(agg)(
+            sync_committee_bits=list(agg.sync_committee_bits),
+            sync_committee_signature=bytes(bad_sig),
+        )
+        bad_update = create_update(
+            attested_state, fin_state, bad_agg,
+            signature_slot=head_block.message.slot, E=E,
+        )
+        with pytest.raises(LightClientError):
+            process_light_client_update(
+                store.__class__(
+                    finalized_header=store.finalized_header,
+                    current_sync_committee=attested_state.current_sync_committee,
+                ),
+                bad_update,
+                current_slot=head_block.message.slot,
+                spec=spec,
+                E=E,
+                genesis_validators_root=hr.chain.genesis_validators_root,
+            )
+    finally:
+        bls.set_backend("fake_crypto")
+
+
+def test_sync_committee_period_rollover(chain):
+    """Crossing a sync-committee period boundary rotates next→current."""
+    from lighthouse_tpu.light_client import LightClientStore, _period
+
+    h = chain
+    E_ = E
+    # synthetic store just below a period boundary
+    boot_state = h.chain.head_state.copy()
+    boot = create_bootstrap(boot_state, E_)
+    store = initialize_light_client_store(
+        boot.header.beacon.hash_tree_root(), boot, E_
+    )
+    period_len = E_.SLOTS_PER_EPOCH * E_.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    old_next = boot_state.next_sync_committee
+    store.next_sync_committee = old_next
+    store.finalized_header.beacon.slot = period_len - 1
+
+    # craft a consistent update finalizing INTO the next period: mutate the
+    # header slots, then point the attested state's finalized checkpoint at
+    # the crafted finalized header so the produced branch proves it
+    from lighthouse_tpu.light_client import _block_header_of, build_light_client_types
+
+    lt = build_light_client_types(E_)
+    fin_state = h.chain.head_state.copy()
+    fin_state.latest_block_header.slot = period_len + 1
+    fin_header = _block_header_of(fin_state, lt)
+    attested = h.chain.head_state.copy()
+    attested.latest_block_header.slot = period_len + 5
+    t = lt.base
+    attested.finalized_checkpoint = t.Checkpoint(
+        epoch=(period_len + 1) // E_.SLOTS_PER_EPOCH,
+        root=fin_header.beacon.hash_tree_root(),
+    )
+    sync_agg = h.make_sync_aggregate(
+        h.chain.head_state.copy(), h.chain.head_state.slot + 1, h.chain.head_root
+    )
+    update = create_update(
+        attested, fin_state, sync_agg,
+        signature_slot=period_len + 6, E=E_,
+    )
+    process_light_client_update(
+        store, update, current_slot=period_len + 7, spec=h.spec, E=E_,
+        genesis_validators_root=h.chain.genesis_validators_root,
+    )
+    assert _period(store.finalized_header.beacon.slot, E_) >= 1
+    # rotation happened: current is the previously stored next
+    assert store.current_sync_committee == old_next

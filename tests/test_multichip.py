@@ -64,3 +64,14 @@ def test_dryrun_multichip_entrypoint():
     from __graft_entry__ import dryrun_multichip
 
     dryrun_multichip(8)
+
+
+@pytest.mark.slow
+def test_sharded_rlc_bls_matches_host(eight_devices):
+    """The sharded BLS batch step (per-device RLC scalar-mul shards + ICI
+    point-sum reduction) matches the host bigint oracle — the multichip
+    half of batch signature verification (SURVEY §2.9)."""
+    from lighthouse_tpu.ops.bls381_sharded import build_sharded_bls, dryrun_sharded_bls
+
+    mesh, fn, sharding = build_sharded_bls(8)
+    dryrun_sharded_bls(mesh)  # asserts vs host internally

@@ -68,3 +68,12 @@ def test_mock_builder_bid_and_unblind():
         bad.message.body = _Blinded()
         bad.message.body.execution_payload_header = t.ExecutionPayloadHeaderCapella()
         builder.submit_blinded_block(bad)
+
+
+def test_wallet_accepts_long_seeds():
+    # 64-byte BIP39-style seeds are the normal EIP-2386 input
+    seed64 = b"\x05" * 64
+    w = Wallet.create("w64", "pw", seed=seed64, _fast_kdf=True)
+    assert w.decrypt_seed("pw") == seed64
+    ks = w.next_validator("pw", "kpw", _fast_kdf=True)
+    assert ks.path == "m/12381/3600/0/0/0"

@@ -1,0 +1,42 @@
+"""watch analytics: updater fills the DB from a live node over HTTP."""
+
+from dataclasses import replace
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.eth2 import BeaconNodeHttpClient
+from lighthouse_tpu.http_api import HttpApiServer
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.containers import build_types
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+from lighthouse_tpu.watch import WatchDB, WatchUpdater
+
+
+def test_watch_updater_records_chain():
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, E, validator_count=16)
+    h.extend_chain(2 * E.SLOTS_PER_EPOCH)
+    server = HttpApiServer(h.chain).start()
+    try:
+        client = BeaconNodeHttpClient(f"http://127.0.0.1:{server.port}")
+        db = WatchDB()
+        updater = WatchUpdater(client, db, build_types(E))
+        n = updater.update()
+        assert n == 2 * E.SLOTS_PER_EPOCH  # slots 1..16 (no skips)
+        counts = db.proposer_counts()
+        assert sum(counts.values()) == 2 * E.SLOTS_PER_EPOCH
+        assert db.missed_slots() == []
+        just, fin = db.latest_finality()
+        assert just >= 0 and fin >= 0
+        # idempotent second run records nothing new
+        assert updater.update() == 0
+
+        # a skipped slot shows up as missed
+        skip_to = h.chain.head_state.slot + 2
+        h.slot_clock.set_slot(skip_to)
+        h.add_block_at_slot(skip_to)
+        assert updater.update() == 2
+        assert db.missed_slots() == [skip_to - 1]
+    finally:
+        server.stop()
