@@ -20,12 +20,18 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "details"}.
 
 import hashlib
 import json
+import os
 import random
 import statistics
 import sys
 import time
 
 import numpy as np
+
+# BENCH_SMOKE=1 shrinks every metric to test-scale shapes (kernels already
+# compiled by the test suite's persistent cache) — a fast wiring check on
+# slow hosts; real numbers come from the full-size run.
+SMOKE = os.environ.get("BENCH_SMOKE") == "1"
 
 
 def _trials(fn, n=3):
@@ -49,7 +55,7 @@ def bench_merkle(jax):
         words_to_bytes,
     )
 
-    n_leaves = 1 << 20
+    n_leaves = 1 << 12 if SMOKE else 1 << 20
     rng = np.random.default_rng(7)
     data = rng.integers(0, 256, size=n_leaves * 32, dtype=np.uint8).tobytes()
     leaves = bytes_to_words(data)
@@ -110,7 +116,9 @@ def bench_bls(jax):
     from lighthouse_tpu.ops.bls381_verify import verify_signature_sets_device_full
 
     bls.set_backend("host")
-    n_sets, committee = 1024, 64
+    # smoke shapes match the device test-suite buckets (16-lane sets,
+    # 4-lane committees) so the persistent cache serves every kernel
+    n_sets, committee = (9, 3) if SMOKE else (1024, 64)
     sets = _make_sets(bls, n_sets, committee)
 
     def dev_run():
@@ -179,7 +187,7 @@ def bench_epoch_transition(jax):
 
     E = MinimalEthSpec
     bls.set_backend("fake_crypto")
-    n = 100_000
+    n = 2_000 if SMOKE else 100_000
     spec = replace(minimal_spec(), altair_fork_epoch=0)
     base = interop_genesis_state(
         bls.interop_keypairs(8), 1_600_000_000, b"\x42" * 32, spec, E

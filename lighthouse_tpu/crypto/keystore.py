@@ -73,11 +73,13 @@ class Keystore:
         description: str = "",
         _fast_kdf: bool = False,
     ) -> "Keystore":
-        if len(secret) != 32 and pubkey is None:
-            # EIP-2335 proper encrypts 32-byte BLS secrets; the EIP-2386
-            # wallet reuses this crypto for longer seeds and passes an
-            # explicit (empty) pubkey since none can be derived
-            raise KeystoreError("BLS secret must be 32 bytes")
+        if len(secret) != 32:
+            # EIP-2335 proper encrypts 32-byte BLS secrets. The single
+            # sanctioned exception: EIP-2386 wallet SEEDS (≥32 bytes),
+            # marked by an explicitly EMPTY pubkey — a caller passing a
+            # real pubkey still gets its secret length validated.
+            if not (pubkey == b"" and len(secret) >= 32):
+                raise KeystoreError("BLS secret must be 32 bytes")
         salt = os.urandom(32)
         iv = os.urandom(16)
         if kdf == "scrypt":

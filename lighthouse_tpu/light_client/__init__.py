@@ -93,10 +93,6 @@ def _state_field_branch(state, field_name: str) -> list[bytes]:
     return compute_merkle_proof(chunks, index, limit=1 << NEXT_SYNC_COMMITTEE_DEPTH)
 
 
-def _state_field_index(state, field_name: str) -> int:
-    return list(type(state)._fields).index(field_name)
-
-
 def _block_header_of(state, lt):
     header = state.latest_block_header
     filled = lt.base.BeaconBlockHeader(
@@ -128,14 +124,9 @@ def create_update(attested_state, finalized_state, sync_aggregate, signature_slo
     """LightClientUpdate proving next_sync_committee + finality from the
     attested state, signed by `sync_aggregate` at `signature_slot`."""
     lt = build_light_client_types(E)
-    # finality branch: checkpoint.root within the state tree
-    cls = type(attested_state)
-    fields = list(cls._fields.items())
-    chunks = [ft.hash_tree_root_of(getattr(attested_state, f)) for f, ft in fields]
-    fin_index = [f for f, _ in fields].index("finalized_checkpoint")
-    state_branch = compute_merkle_proof(
-        chunks, fin_index, limit=1 << NEXT_SYNC_COMMITTEE_DEPTH
-    )
+    # finality branch: checkpoint.root within the state tree (shared helper
+    # keeps the >32-field guard and the single chunk computation)
+    state_branch = _state_field_branch(attested_state, "finalized_checkpoint")
     cp = attested_state.finalized_checkpoint
     # within Checkpoint (2 fields): root is index 1; sibling = epoch chunk
     epoch_chunk = int(cp.epoch).to_bytes(32, "little")
@@ -255,12 +246,26 @@ def process_light_client_update(
     ):
         raise LightClientError("invalid next_sync_committee branch")
 
-    # sync-committee signature over the attested header
+    # sync-committee signature over the attested header. The signing
+    # committee is selected by the SIGNATURE slot's period: the store's
+    # current committee for its own period, the stored next committee when
+    # the signature crosses into the following period (spec
+    # validate_light_client_update committee selection).
     agg = update.sync_aggregate
     bits = list(agg.sync_committee_bits)
     if sum(bits) < MIN_SYNC_COMMITTEE_PARTICIPANTS:
         raise LightClientError("insufficient sync participation")
-    committee = store.current_sync_committee
+    store_period = _period(store.finalized_header.beacon.slot, E)
+    signature_period = _period(max(update.signature_slot - 1, 0), E)
+    if signature_period == store_period:
+        committee = store.current_sync_committee
+    elif signature_period == store_period + 1 and store.next_sync_committee is not None:
+        committee = store.next_sync_committee
+    else:
+        raise LightClientError(
+            f"signature period {signature_period} not covered by the store "
+            f"(store period {store_period})"
+        )
     pubkeys = [
         bls.PublicKey(bytes(pk))
         for pk, bit in zip(committee.pubkeys, bits)
@@ -282,8 +287,15 @@ def process_light_client_update(
         if not aggsig.fast_aggregate_verify(pubkeys, signing_root):
             raise LightClientError("invalid sync committee signature")
 
-    # apply (spec apply_light_client_update, finalized flow)
-    if is_finality_update and fin.slot > store.finalized_header.beacon.slot:
+    # apply (spec apply_light_client_update, finalized flow): finality only
+    # advances on a 2/3 supermajority — this IS the light client's security
+    # model; a lone compromised key must never move the finalized head
+    supermajority = 3 * sum(bits) >= 2 * len(bits)
+    if (
+        supermajority
+        and is_finality_update
+        and fin.slot > store.finalized_header.beacon.slot
+    ):
         # period computed from the PRE-update finalized header — after the
         # reassignment both sides would be the new slot and rotation would
         # never fire

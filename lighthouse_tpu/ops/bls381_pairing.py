@@ -193,6 +193,19 @@ def _add_step(T, q_x, q_y, q_jac_one, xp, yp):
     return _line_to_f12(a0, b1, b2), T_new
 
 
+# double-and-add op schedule (host-precomputed): one scan step per group
+# op instead of a fused dbl+add+select body. The schedule halves the scan
+# body (each step is ONE of the two branches, compiled as separate HLO
+# computations under lax.cond) and skips the wasted always-computed add of
+# the branchless form — fewer flops AND tractable LLVM compiles.
+_MILLER_OPS = []
+for _b in _ATE_TAIL_BITS:
+    _MILLER_OPS.append(0)  # double step
+    if _b:
+        _MILLER_OPS.append(1)  # add step
+_MILLER_OPS = np.array(_MILLER_OPS, dtype=np.int32)
+
+
 def miller_loop_batch(xp, yp, q_x, q_y):
     """Batched f_{|x|,Q}(P), conjugated for x<0. Inputs: G1 affine limbs
     [n, 48]×2, G2 (twisted) affine limbs [n, 2, 48]×2. Returns [n] Fq12.
@@ -201,23 +214,22 @@ def miller_loop_batch(xp, yp, q_x, q_y):
     one2 = _one_fq2(batch)
     T0 = (q_x, q_y, one2)
     f0 = f12_ones(batch)
-    bits = jnp.asarray(_ATE_TAIL_BITS)
+    ops = jnp.asarray(_MILLER_OPS)
 
-    def body(carry, bit):
+    def dbl_branch(carry):
         T, f = carry
-        line_d, T = _dbl_step(T, xp, yp)
-        f = f12_mul(f12_sqr(f), line_d)
-        line_a, T_added = _add_step(T, q_x, q_y, one2, xp, yp)
-        f_added = f12_mul(f, line_a)
-        take = bit > 0
-        f = f12_select(jnp.broadcast_to(take, batch), f_added, f)
-        T = tuple(
-            f2_select(jnp.broadcast_to(take, batch), tn, to)
-            for tn, to in zip(T_added, T)
-        )
-        return (T, f), None
+        line, T2 = _dbl_step(T, xp, yp)
+        return (T2, f12_mul(f12_sqr(f), line))
 
-    (_, f), _ = lax.scan(body, (T0, f0), bits)
+    def add_branch(carry):
+        T, f = carry
+        line, T2 = _add_step(T, q_x, q_y, one2, xp, yp)
+        return (T2, f12_mul(f, line))
+
+    def body(carry, op):
+        return lax.cond(op > 0, add_branch, dbl_branch, carry), None
+
+    (_, f), _ = lax.scan(body, (T0, f0), ops)
     return f12_conj(f)  # x < 0
 
 
@@ -231,26 +243,39 @@ def miller_loop_batch(xp, yp, q_x, q_y):
 
 _miller_jit = jax.jit(miller_loop_batch)
 
-_X_BITS_64 = np.array([(_ATE >> i) & 1 for i in range(64)], dtype=np.int32)
+# |x|-power op schedule (LSB-first square-and-multiply, one op per scan
+# step — same body-splitting rationale as the Miller schedule)
+_POW_X_OPS = []
+for _i in range(64):
+    if (_ATE >> _i) & 1:
+        _POW_X_OPS.append(1)  # acc ×= base
+    _POW_X_OPS.append(0)  # base ²= (harmless past the top bit)
+_POW_X_OPS = np.array(_POW_X_OPS, dtype=np.int32)
 
 
 @jax.jit
-def _jit_f12_pow_var(a, bits):
-    """a^e for runtime LSB-first bits — the shared f12 square-and-multiply."""
+def _jit_f12_pow_x(a):
+    """a^|x| via the fixed schedule."""
     one = f12_ones(a.shape[:-4])
 
-    def body(carry, bit):
+    def mul_branch(carry):
         acc, base = carry
-        acc = jnp.where(bit > 0, f12_mul(acc, base), acc)
-        return (acc, f12_sqr(base)), None
+        return (f12_mul(acc, base), base)
 
-    (acc, _), _ = lax.scan(body, (one, a), bits)
+    def sqr_branch(carry):
+        acc, base = carry
+        return (acc, f12_sqr(base))
+
+    def body(carry, op):
+        return lax.cond(op > 0, mul_branch, sqr_branch, carry), None
+
+    (acc, _), _ = lax.scan(body, (one, a), jnp.asarray(_POW_X_OPS))
     return acc
 
 
 def _pow_x_conj(a):
     """a^x = conj(a^|x|) (x < 0)."""
-    return _jit_f12_conj(_jit_f12_pow_var(a, jnp.asarray(_X_BITS_64)))
+    return _jit_f12_conj(_jit_f12_pow_x(a))
 
 
 _jit_f12_mul = jax.jit(f12_mul)
@@ -280,8 +305,8 @@ def final_exp_cubed(F):
     Cube of the host oracle's final_exponentiation; identical for ==1
     checks. Python orchestration over staged jits."""
     t = _jit_easy_part(F, _jit_f12_inv(F))
-    y1 = _jit_f12_conj(_jit_f12_mul(_jit_f12_pow_var(t, jnp.asarray(_X_BITS_64)), t))
-    y2 = _jit_f12_conj(_jit_f12_mul(_jit_f12_pow_var(y1, jnp.asarray(_X_BITS_64)), y1))
+    y1 = _jit_f12_conj(_jit_f12_mul(_jit_f12_pow_x(t), t))
+    y2 = _jit_f12_conj(_jit_f12_mul(_jit_f12_pow_x(y1), y1))
     y3 = _jit_f12_mul(_pow_x_conj(y2), _jit_f12_frob(y2))  # ^(x+p)
     a = _pow_x_conj(y3)  # y3^x
     b = _pow_x_conj(a)  # y3^(x²)

@@ -28,7 +28,21 @@ class WatchDB:
             "checked_at_slot INTEGER PRIMARY KEY, "
             "justified_epoch INTEGER, finalized_epoch INTEGER)"
         )
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS gaps ("
+            "lo INTEGER, hi INTEGER)"
+        )
         self._conn.commit()
+
+    def record_gap(self, lo: int, hi: int):
+        """History the node could not serve — these slots stay unrecorded
+        and queries over them are knowingly incomplete."""
+        with self._lock:
+            self._conn.execute("INSERT INTO gaps VALUES (?, ?)", (lo, hi))
+            self._conn.commit()
+
+    def gaps(self) -> list[tuple[int, int]]:
+        return self._conn.execute("SELECT lo, hi FROM gaps ORDER BY lo").fetchall()
 
     def record_slot(self, slot: int, root: bytes | None, proposer: int | None):
         with self._lock:
@@ -119,6 +133,10 @@ class WatchUpdater:
         # reached below it — an incomplete walk must leave a hole, never
         # record real proposals as missed (rows are write-once).
         certainty_floor = start if walk_complete else min(blocks_by_slot)
+        if certainty_floor > start:
+            # the hole is permanent (rows advance past it); record it so
+            # queries are explicitly known-incomplete instead of silently so
+            self.db.record_gap(start, certainty_floor - 1)
         recorded = 0
         for slot in range(start, head_slot + 1):
             ent = blocks_by_slot.get(slot)
