@@ -33,6 +33,12 @@ import numpy as np
 # slow hosts; real numbers come from the full-size run.
 SMOKE = os.environ.get("BENCH_SMOKE") == "1"
 
+# Persistent compile cache: pairing-class kernels take minutes to compile;
+# cache across runs (and across warm-up runs before the driver's bench).
+from lighthouse_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 
 def _trials(fn, n=3):
     out = []

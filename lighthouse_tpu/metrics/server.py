@@ -1,0 +1,65 @@
+"""Standalone Prometheus metrics server.
+
+The beacon_node/http_metrics analog (272 LoC crate): a tiny HTTP server
+exposing the process-global registry's text exposition at /metrics and a
+liveness probe at /health, independent of the Beacon API server so
+operators can firewall the two separately (the reference binds them on
+different ports for the same reason)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import REGISTRY
+from .system_health import observe_system_health
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry = REGISTRY
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def do_GET(self):
+        if self.path.split("?")[0] == "/metrics":
+            # refresh host gauges at scrape time, as the reference's
+            # gather() does per scrape
+            observe_system_health()
+            body = self.registry.expose().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+        elif self.path.split("?")[0] == "/health":
+            body = b"OK"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+        else:
+            body = b"not found"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class MetricsServer:
+    """http_metrics/src/lib.rs analog."""
+
+    def __init__(self, port: int = 0, registry=REGISTRY):
+        handler = type("_BoundHandler", (_Handler,), {"registry": registry})
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._server.server_port
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="http-metrics"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

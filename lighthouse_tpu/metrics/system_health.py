@@ -1,0 +1,107 @@
+"""Host health stats: CPU, memory, disk, network.
+
+The common/system_health analog (src/lib.rs): a snapshot struct consumed
+by the monitoring push API and exposed as gauges for the metrics server.
+Reads /proc directly (Linux-only in this image; every field degrades to 0
+where a source is missing, as the reference's sysinfo does on unsupported
+platforms)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from dataclasses import asdict, dataclass
+
+from . import set_gauge
+
+
+@dataclass
+class SystemHealth:
+    total_memory_bytes: int
+    free_memory_bytes: int
+    used_memory_bytes: int
+    sys_loadavg_1: float
+    sys_loadavg_5: float
+    sys_loadavg_15: float
+    cpu_cores: int
+    disk_bytes_total: int
+    disk_bytes_free: int
+    network_bytes_sent: int
+    network_bytes_received: int
+    observed_at: float
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def _meminfo() -> tuple[int, int]:
+    total = free = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    free = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return total, free
+
+
+def _net_counters() -> tuple[int, int]:
+    sent = recv = 0
+    try:
+        with open("/proc/net/dev") as f:
+            for line in f.readlines()[2:]:
+                iface, _, rest = line.partition(":")
+                if iface.strip() == "lo":
+                    continue
+                cols = rest.split()
+                recv += int(cols[0])
+                sent += int(cols[8])
+    except (OSError, IndexError, ValueError):
+        pass
+    return sent, recv
+
+
+def system_health(path: str = "/") -> SystemHealth:
+    total, free = _meminfo()
+    try:
+        load1, load5, load15 = os.getloadavg()
+    except OSError:
+        load1 = load5 = load15 = 0.0
+    try:
+        du = shutil.disk_usage(path)
+        disk_total, disk_free = du.total, du.free
+    except OSError:
+        disk_total = disk_free = 0
+    sent, recv = _net_counters()
+    return SystemHealth(
+        total_memory_bytes=total,
+        free_memory_bytes=free,
+        used_memory_bytes=max(0, total - free),
+        sys_loadavg_1=load1,
+        sys_loadavg_5=load5,
+        sys_loadavg_15=load15,
+        cpu_cores=os.cpu_count() or 0,
+        disk_bytes_total=disk_total,
+        disk_bytes_free=disk_free,
+        network_bytes_sent=sent,
+        network_bytes_received=recv,
+        observed_at=time.time(),
+    )
+
+
+def observe_system_health():
+    """Publish the snapshot as gauges (scrape-time refresh)."""
+    h = system_health()
+    set_gauge("system_total_memory_bytes", h.total_memory_bytes)
+    set_gauge("system_free_memory_bytes", h.free_memory_bytes)
+    set_gauge("system_loadavg_1", h.sys_loadavg_1)
+    set_gauge("system_cpu_cores", h.cpu_cores)
+    set_gauge("system_disk_bytes_total", h.disk_bytes_total)
+    set_gauge("system_disk_bytes_free", h.disk_bytes_free)
+    set_gauge("system_network_bytes_sent", h.network_bytes_sent)
+    set_gauge("system_network_bytes_received", h.network_bytes_received)
+    return h

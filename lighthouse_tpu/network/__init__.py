@@ -66,22 +66,61 @@ class PeerManager:
         self._peers: dict[str, Peer] = {}
         self._lock = threading.Lock()
 
-    def add(self, peer: Peer):
+    def add(self, peer: Peer) -> bool:
+        """Register a peer. Refused (False) when the peer id is banned —
+        redialing must not mint a fresh unbanned identity (peerdb keeps
+        banned peers listed for exactly this reason). A reconnect of a
+        known peer inherits its score (reconnecting must not launder a
+        bad score back to 0) and releases the stale socket."""
+        stale_sock = None
         with self._lock:
+            existing = self._peers.get(peer.peer_id)
+            if existing is not None:
+                if existing.banned:
+                    return False
+                peer.score = existing.score
+                with existing.lock:
+                    stale_sock = existing.gossip_sock
+                    existing.gossip_sock = None
             self._peers[peer.peer_id] = peer
-        set_gauge("network_peers", len(self._peers))
+            n = self._gauge_count()
+        if stale_sock is not None:
+            try:
+                stale_sock.close()
+            except OSError:
+                pass
+        set_gauge("network_peers", n)
+        return True
+
+    def is_banned(self, peer_id: str) -> bool:
+        with self._lock:
+            p = self._peers.get(peer_id)
+            return p is not None and p.banned
+
+    def _gauge_count(self) -> int:
+        """Connected (non-banned) peers — call under self._lock."""
+        return sum(1 for p in self._peers.values() if not p.banned)
 
     def remove(self, peer_id: str):
+        """Drop a disconnected peer. Banned entries are kept — a ban must
+        survive the connection teardown that usually follows it (peerdb's
+        ban list outlives the session)."""
         with self._lock:
-            self._peers.pop(peer_id, None)
-        set_gauge("network_peers", len(self._peers))
+            p = self._peers.get(peer_id)
+            if p is not None and not p.banned:
+                self._peers.pop(peer_id)
+            n = self._gauge_count()
+        set_gauge("network_peers", n)
 
     def peers(self) -> list[Peer]:
         with self._lock:
             return [p for p in self._peers.values() if not p.banned]
 
     def report(self, peer_id: str, delta: float) -> Peer | None:
-        """Score adjustment; banning at threshold (score.rs behavior)."""
+        """Score adjustment; banning at threshold (score.rs behavior). A
+        fresh ban also severs the live connection — the reference
+        disconnects banned peers, not just future redials."""
+        newly_banned = None
         with self._lock:
             p = self._peers.get(peer_id)
             if p is None:
@@ -89,8 +128,20 @@ class PeerManager:
             p.score = min(MAX_SCORE, p.score + delta)
             if p.score <= BAN_THRESHOLD and not p.banned:
                 p.banned = True
+                newly_banned = p
                 inc_counter("network_peers_banned_total")
-            return p
+            n = self._gauge_count()
+        if newly_banned is not None:
+            set_gauge("network_peers", n)
+            # close outside the manager lock (peer.lock orders with publish)
+            with newly_banned.lock:
+                if newly_banned.gossip_sock is not None:
+                    try:
+                        newly_banned.gossip_sock.close()
+                    except OSError:
+                        pass
+                    newly_banned.gossip_sock = None
+        return p
 
 
 class GossipRouter:
@@ -280,7 +331,13 @@ class NetworkService:
     sync manager, and bridges gossip to the beacon chain (the network
     crate's Router + NetworkBeaconProcessor roles in one place)."""
 
-    def __init__(self, chain, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        chain,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        bootnodes=None,
+    ):
         self.chain = chain
         self.spec = chain.spec
         self.peers = PeerManager()
@@ -290,6 +347,18 @@ class NetworkService:
         self.server = RpcServer(self, host, port)
         self.port = self.server.port
         self._stopping = False
+        # discv5 analog: advertise our record, bootstrap from bootnodes
+        # (None → discovery disabled, as with the reference's --disable-discovery)
+        self.discovery = None
+        if bootnodes is not None:
+            from .discovery import DiscoveryService
+
+            self.discovery = DiscoveryService(
+                tcp_port=self.port,
+                fork_digest=self.fork_digest(),
+                host=host,
+                bootnodes=list(bootnodes),
+            )
 
         digest = self.fork_digest()
         self.topic_block = M.gossip_topic(digest, M.TOPIC_BEACON_BLOCK)
@@ -301,10 +370,37 @@ class NetworkService:
 
     def start(self):
         self.server.start()
+        if self.discovery is not None:
+            self.discovery.start()
         return self
+
+    def discover_and_connect(self, max_peers: int = 8) -> int:
+        """One discovery round → dial every new connectable record
+        (discovery.rs find_peers → peer_manager dial flow)."""
+        if self.discovery is None:
+            return 0
+        self.discovery.maintain()  # evict stale records before querying
+        connected = 0
+        have = {(p.host, p.port) for p in self.peers.peers()}
+        local_id = self.discovery.local_enr.node_id
+        for enr in self.discovery.discover():
+            if connected >= max_peers:
+                break
+            addr = (enr.ip, enr.tcp_port)
+            if addr in have or enr.node_id == local_id:
+                continue
+            try:
+                self.connect(*addr)  # refuses banned peers before dialing
+            except Exception:  # noqa: BLE001 — dead record; discovery moves on
+                continue
+            have.add(addr)
+            connected += 1
+        return connected
 
     def stop(self):
         self._stopping = True
+        if self.discovery is not None:
+            self.discovery.stop()
         for p in self.peers.peers():
             try:
                 p.client.goodbye(M.GOODBYE_CLIENT_SHUTDOWN)
@@ -337,6 +433,8 @@ class NetworkService:
     def connect(self, host: str, port: int) -> Peer:
         """Dial a peer: Status handshake (irrelevant-network check), then a
         persistent gossip stream."""
+        if self.peers.is_banned(f"{host}:{port}"):
+            raise RpcError("peer is banned")
         client = RpcClient(host, port)
         status = client.status(self.local_status())
         if bytes(status.fork_digest) != self.fork_digest():
@@ -350,7 +448,15 @@ class NetworkService:
         _send_protocol(peer.gossip_sock, M.PROTO_GOSSIP)
         # announce our listening port so the peer can identify us
         _send_block(peer.gossip_sock, self.port.to_bytes(4, "little"))
-        self.peers.add(peer)
+        if not self.peers.add(peer):
+            # refusal cleanup must not mask the refusal: close/goodbye are
+            # best-effort against a peer that may already be gone
+            try:
+                peer.gossip_sock.close()
+                client.goodbye(M.GOODBYE_BANNED)
+            except (OSError, RpcError):
+                pass
+            raise RpcError("peer is banned")
         t = threading.Thread(
             target=self._gossip_reader,
             args=(peer.gossip_sock, peer.peer_id),
@@ -383,7 +489,12 @@ class NetworkService:
             client=RpcClient(host, listen_port),
             gossip_sock=sock,
         )
-        self.peers.add(peer)
+        if not self.peers.add(peer):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
         self._gossip_reader(sock, peer.peer_id)
 
     def _gossip_reader(self, sock, peer_id: str):
@@ -408,6 +519,8 @@ class NetworkService:
             except Exception:  # noqa: BLE001
                 self.peers.report(peer_id, SCORE_INVALID_MESSAGE)
                 continue
+            if self.peers.is_banned(peer_id):
+                break  # ban landed while this frame was in flight
             self.gossip.publish(topic, data, origin=peer_id)
 
     # -- chain bridging (network_beacon_processor/gossip_methods.rs) ------------

@@ -1,0 +1,24 @@
+"""Shared JAX persistent-compile-cache configuration.
+
+Pairing-class kernels take minutes to compile on this image's XLA-CPU;
+every entry point (tests, bench, driver dryrun) must point at the same
+on-disk cache so compiles amortize across processes. Keep the settings
+here — the one place — and call `enable_compile_cache()` before kernels
+are traced."""
+
+from __future__ import annotations
+
+import os
+
+#: repo root = parent of the lighthouse_tpu package
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+CACHE_DIR = os.path.join(REPO_ROOT, ".jax_cache")
+
+
+def enable_compile_cache(cache_dir: str | None = None):
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir or CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -20,5 +20,6 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 # Persistent compile cache: this XLA CPU build compiles slowly; cache across runs.
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from lighthouse_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()

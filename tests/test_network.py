@@ -111,6 +111,15 @@ def test_invalid_gossip_downscores_and_bans(two_nodes):
             break
         time.sleep(0.05)
     assert target is not None and target.banned
+    # the ban severs the live connection (not just future redials): A
+    # closes the gossip socket, so B's further floods never reach A's chain
+    deadline = time.time() + 5
+    while time.time() < deadline and target.gossip_sock is not None:
+        time.sleep(0.05)
+    assert target.gossip_sock is None
+    # and A refuses to dial the banned peer again
+    with pytest.raises(RpcError):
+        na.connect("127.0.0.1", target.port)
 
 
 def test_fork_digest_mismatch_rejected():

@@ -1,0 +1,142 @@
+"""Standalone metrics server, system health, monitoring push, discovery.
+
+Covers the http_metrics crate analog (/metrics text exposition on its own
+port), common/system_health, common/monitoring_api (push payload shape +
+failure isolation), and the discv5-analog discovery layer with a
+standalone boot node (boot_node crate)."""
+
+import json
+import time
+import urllib.request
+
+from lighthouse_tpu.metrics import REGISTRY, inc_counter, set_gauge
+from lighthouse_tpu.metrics.monitoring import MonitoringService
+from lighthouse_tpu.metrics.server import MetricsServer
+from lighthouse_tpu.metrics.system_health import observe_system_health, system_health
+from lighthouse_tpu.network.discovery import BootNode, DiscoveryService, Enr
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def test_metrics_server_exposition():
+    inc_counter("test_obs_requests_total", amount=3)
+    set_gauge("test_obs_queue_depth", 7, queue="gossip")
+    srv = MetricsServer().start()
+    try:
+        code, body = _get(f"http://127.0.0.1:{srv.port}/metrics")
+        assert code == 200
+        assert "test_obs_requests_total 3" in body
+        assert 'test_obs_queue_depth{queue="gossip"} 7' in body
+        # scrape-time system gauges refreshed
+        assert "system_cpu_cores" in body
+        code, body = _get(f"http://127.0.0.1:{srv.port}/health")
+        assert (code, body) == (200, "OK")
+    finally:
+        srv.stop()
+
+
+def test_system_health_snapshot():
+    h = system_health()
+    assert h.total_memory_bytes > 0
+    assert h.cpu_cores >= 1
+    assert h.disk_bytes_total > 0
+    observe_system_health()
+    assert REGISTRY.gauge("system_cpu_cores").value() >= 1
+
+
+def test_monitoring_push_payload_and_failure_isolation():
+    sent = []
+
+    class _Store:
+        def pubkeys(self):
+            return [b"\x01" * 48, b"\x02" * 48]
+
+    svc = MonitoringService(
+        "http://example.invalid/api",
+        validator_store=_Store(),
+        sender=lambda ep, payload: sent.append((ep, payload)),
+    )
+    svc.send()
+    assert len(sent) == 1
+    records = json.loads(sent[0][1])
+    assert records[0]["process"] == "validator"
+    assert records[0]["validator_total"] == 2
+    assert records[0]["client_name"] == "lighthouse_tpu"
+
+    # a raising sender must not propagate (monitoring never kills the node)
+    def boom(ep, payload):
+        raise ConnectionError("no egress")
+
+    svc.sender = boom
+    svc.send()  # no raise
+
+
+def test_discovery_bootstrap_via_boot_node():
+    boot = BootNode().start()
+    a = DiscoveryService(tcp_port=9001, bootnodes=[boot.enr()]).start()
+    b = DiscoveryService(tcp_port=9002, bootnodes=[boot.enr()]).start()
+    try:
+        # registering round: each node queries the bootnode (which learns it)
+        a.discover()
+        b.discover()
+        # now A can find B through the bootnode's table
+        found = a.discover()
+        ports = {e.tcp_port for e in found}
+        assert 9002 in ports
+        assert b.ping(a.local_enr)
+    finally:
+        a.stop()
+        b.stop()
+        boot.stop()
+
+
+def test_discovery_subnet_predicates_and_seq():
+    boot = BootNode().start()
+    a = DiscoveryService(tcp_port=9101, bootnodes=[boot.enr()]).start()
+    b = DiscoveryService(tcp_port=9102, bootnodes=[boot.enr()]).start()
+    try:
+        b.update_subnets([3, 7])
+        assert b.local_enr.seq == 2
+        a.discover()
+        b.discover()  # b registers its subnet-bearing record
+        hits = a.discover(subnet=7)
+        assert any(e.tcp_port == 9102 for e in hits)
+        assert not any(e.tcp_port == 9102 for e in a.discover(subnet=5))
+    finally:
+        a.stop()
+        b.stop()
+        boot.stop()
+
+
+def test_banned_peer_cannot_reregister():
+    """peerdb semantics: a ban survives redial — add() refuses, so neither
+    inbound registration nor discovery reconnects can mint a fresh
+    unbanned identity for the same peer id."""
+    from lighthouse_tpu.network import BAN_THRESHOLD, Peer, PeerManager
+
+    pm = PeerManager()
+    p = Peer(host="127.0.0.1", port=9300, client=None)
+    assert pm.add(p)
+    pm.report(p.peer_id, BAN_THRESHOLD)  # drive to ban
+    assert pm.is_banned(p.peer_id)
+    fresh = Peer(host="127.0.0.1", port=9300, client=None)
+    assert not pm.add(fresh)
+    assert pm.is_banned(p.peer_id)
+    assert fresh not in pm.peers()
+
+
+def test_enr_roundtrip_and_stale_eviction():
+    e = Enr(node_id="ab", ip="127.0.0.1", udp_port=1, tcp_port=2,
+            fork_digest="deadbeef", seq=3, subnets=[1])
+    assert Enr.from_dict(e.to_dict()) == e
+
+    d = DiscoveryService(tcp_port=1)
+    d.add_record(e)
+    assert d.records()
+    d._last_seen["ab"] = time.monotonic() - DiscoveryService.RECORD_TTL - 1
+    d.maintain()
+    assert not d.records()
+    d.stop()
