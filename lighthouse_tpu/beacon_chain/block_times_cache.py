@@ -1,0 +1,77 @@
+"""Per-block latency attribution.
+
+The beacon_chain/src/block_times_cache.rs analog: timestamps each block's
+pipeline milestones (observed on gossip, execution verified, imported,
+became head) keyed by block root, exposes the deltas as histograms, and
+prunes with finality. This is the fine-grained latency breakdown the
+reference logs as `delay` fields on block import."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..metrics import observe
+
+
+@dataclass
+class BlockTimes:
+    slot: int
+    observed_at: float | None = None
+    execution_done_at: float | None = None
+    imported_at: float | None = None
+    became_head_at: float | None = None
+    all_delays: dict = field(default_factory=dict)
+
+
+class BlockTimesCache:
+    MAX_ENTRIES = 64  # a few epochs of blocks; pruned with finality anyway
+
+    def __init__(self):
+        self._times: dict[bytes, BlockTimes] = {}
+
+    def _entry(self, block_root: bytes, slot: int) -> BlockTimes:
+        e = self._times.get(block_root)
+        if e is None:
+            if len(self._times) >= self.MAX_ENTRIES:
+                oldest = min(self._times, key=lambda r: self._times[r].slot)
+                self._times.pop(oldest)
+            e = BlockTimes(slot=slot)
+            self._times[block_root] = e
+        return e
+
+    # -- milestones ------------------------------------------------------
+
+    def set_observed(self, block_root: bytes, slot: int, t: float):
+        e = self._entry(block_root, slot)
+        if e.observed_at is None:
+            e.observed_at = t
+
+    def set_execution_done(self, block_root: bytes, slot: int, t: float):
+        self._entry(block_root, slot).execution_done_at = t
+
+    def set_imported(self, block_root: bytes, slot: int, t: float):
+        e = self._entry(block_root, slot)
+        e.imported_at = t
+        if e.observed_at is not None:
+            delay = t - e.observed_at
+            e.all_delays["observed_to_imported"] = delay
+            observe("beacon_block_observed_to_imported_seconds", delay)
+
+    def set_became_head(self, block_root: bytes, slot: int, t: float):
+        e = self._entry(block_root, slot)
+        e.became_head_at = t
+        if e.imported_at is not None:
+            delay = t - e.imported_at
+            e.all_delays["imported_to_head"] = delay
+            observe("beacon_block_imported_to_head_seconds", delay)
+
+    # -- queries ---------------------------------------------------------
+
+    def get(self, block_root: bytes) -> BlockTimes | None:
+        return self._times.get(block_root)
+
+    def prune(self, finalized_slot: int):
+        for root in [
+            r for r, e in self._times.items() if e.slot < finalized_slot
+        ]:
+            self._times.pop(root)

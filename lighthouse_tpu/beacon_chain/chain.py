@@ -10,6 +10,7 @@ cache, and finalization-driven pruning/migration (migrate.rs).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 from ..fork_choice import ForkChoice
@@ -97,6 +98,20 @@ class BeaconChain:
         self.observed_aggregators = ObservedCache()
         self.observed_block_producers = ObservedCache()
         self.attestation_verifier = AttestationVerifier(self)
+        # auxiliary subsystems (SURVEY §5): SSE events, per-validator
+        # monitoring, latency attribution, next-slot pre-advance, and the
+        # blacklist fork_revert maintains
+        from .block_times_cache import BlockTimesCache
+        from .events import ServerSentEventHandler
+        from .state_advance import StateAdvanceCache
+        from .validator_monitor import ValidatorMonitor
+
+        self.event_handler = ServerSentEventHandler()
+        self.validator_monitor = ValidatorMonitor(E)
+        self.block_times_cache = BlockTimesCache()
+        self.state_advance_cache = StateAdvanceCache()
+        self.invalid_block_roots: set[bytes] = set()
+        self._last_finalized_epoch_seen = 0
 
         genesis_root = _genesis_block_root(genesis_state, self.types)
         self.genesis_block_root = genesis_root
@@ -187,8 +202,68 @@ class BeaconChain:
                         "cache or store"
                     )
                 self._states[new_head] = state
+            old_head = self.head_root
             self.head_root = new_head
+            self._register_head_events(old_head, new_head)
+        self._register_finality_event()
         return self.head_root
+
+    def _register_head_events(self, old_head: bytes, new_head: bytes):
+        """SSE head + chain_reorg emission (canonical_head.rs's
+        `detect_reorg` → events.rs). A reorg is a head move whose new head
+        does not descend from the old head; depth = old head slot minus
+        the common-ancestor slot."""
+        state = self._states[new_head]
+        self.block_times_cache.set_became_head(
+            new_head, state.slot, time.monotonic()
+        )
+        # the head block already commits to its state root — never re-hash
+        # the state just to fill the event
+        head_block = self._blocks_by_root.get(new_head)
+        state_root = (
+            bytes(head_block.message.state_root)
+            if head_block is not None
+            else state.hash_tree_root()
+        )
+        self.event_handler.register_head(new_head, state.slot, state_root)
+        old_block = self._blocks_by_root.get(old_head)
+        if old_block is None:
+            return
+        # walk new head's ancestry down to the old head's slot
+        r = new_head
+        while True:
+            blk = self._blocks_by_root.get(r)
+            if blk is None or blk.message.slot <= old_block.message.slot:
+                break
+            r = bytes(blk.message.parent_root)
+        if r != old_head:
+            # old head is not an ancestor → reorg; find the common ancestor
+            ancestors = set()
+            a = old_head
+            while a in self._blocks_by_root:
+                ancestors.add(a)
+                a = bytes(self._blocks_by_root[a].message.parent_root)
+            b = new_head
+            while b in self._blocks_by_root and b not in ancestors:
+                b = bytes(self._blocks_by_root[b].message.parent_root)
+            common_slot = (
+                self._blocks_by_root[b].message.slot
+                if b in self._blocks_by_root
+                else self.anchor_slot
+            )
+            depth = old_block.message.slot - common_slot
+            from ..metrics import inc_counter
+
+            inc_counter("beacon_chain_reorgs_total")
+            self.event_handler.register_reorg(
+                old_head, new_head, state.slot, depth
+            )
+
+    def _register_finality_event(self):
+        fin = self.finalized_checkpoint
+        if fin.epoch > self._last_finalized_epoch_seen:
+            self._last_finalized_epoch_seen = fin.epoch
+            self.event_handler.register_finalized(fin)
 
     def _justified_state_provider(self, block_root: bytes):
         state = self._states.get(block_root)
@@ -329,7 +404,9 @@ class BeaconChain:
         parent_state = self._states.get(block.parent_root)
         if parent_state is None:
             raise BlockError(f"no state for parent {block.parent_root.hex()[:16]}")
-        state = parent_state.copy()
+        # state_advance_timer fast path: the next-slot state was pre-built
+        advanced = self.state_advance_cache.take(block.parent_root, block.slot)
+        state = advanced if advanced is not None else parent_state.copy()
         while state.slot < block.slot:
             per_slot_processing(state, self.spec, self.E)
         return state
@@ -359,6 +436,8 @@ class BeaconChain:
             proposal_verified = False
         block = signed_block.message
 
+        if block_root in self.invalid_block_roots:
+            raise BlockError("block was reverted as invalid (blacklisted)")
         if self.fork_choice.contains_block(block_root):
             return block_root  # idempotent
         if not self.fork_choice.contains_block(block.parent_root):
@@ -368,6 +447,11 @@ class BeaconChain:
             raise BlockError(
                 f"future block: slot {block.slot} > clock {current_slot}"
             )
+        # only plausibly-importable blocks enter the times cache — garbage
+        # slots would poison its min-slot eviction
+        self.block_times_cache.set_observed(
+            block_root, block.slot, time.monotonic()
+        )
 
         # Deneb availability gate (beacon_chain.rs → data_availability_checker):
         # commitment-carrying blocks need all sidecars KZG-verified first.
@@ -423,6 +507,19 @@ class BeaconChain:
         self.store.put_state(block.state_root, state)
         self._states[block_root] = state
         self._blocks_by_root[block_root] = signed_block
+        self.block_times_cache.set_imported(
+            block_root, block.slot, time.monotonic()
+        )
+        self.event_handler.register_block(block_root, block.slot)
+        self.validator_monitor.process_block(
+            block, block.proposer_index, state, self.spec
+        )
+        # summarize epoch N only once N+1 has fully completed — attestations
+        # from N's last slots are legitimately included early in N+1 (the
+        # reference delays its per-epoch summaries a full epoch for this)
+        completed_epoch = get_current_epoch(state, self.E) - 2
+        if completed_epoch >= 0:
+            self.validator_monitor.process_epoch_rollover(completed_epoch)
 
         self.recompute_head()
         self.op_pool.prune(self.head_state)
@@ -453,6 +550,7 @@ class BeaconChain:
             return
         finalized_slot = compute_start_slot_at_epoch(finalized.epoch, self.E)
         self.data_availability_checker.prune_before(finalized_slot)
+        self.block_times_cache.prune(finalized_slot)
         droppable = [
             root
             for root, st in self._states.items()
@@ -504,6 +602,7 @@ class BeaconChain:
         verified = self.attestation_verifier.verify_unaggregated(attestation)
         self.apply_attestation_to_fork_choice(verified.indexed_attestation)
         self.op_pool.insert_attestation(attestation)
+        self.event_handler.register_attestation(attestation)
         return verified
 
     def process_blob_sidecars(self, block_root: bytes, sidecars: list):

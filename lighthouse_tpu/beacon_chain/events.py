@@ -1,0 +1,153 @@
+"""Server-sent event streams for API consumers.
+
+The beacon_chain/src/events.rs analog: a `ServerSentEventHandler` with one
+broadcast channel per event topic (block, head, finalized_checkpoint,
+chain_reorg, attestation); the chain pushes, any number of subscribers
+drain bounded per-subscriber queues (slow consumers drop oldest — the
+reference's broadcast channel lags the same way). The http_api /events
+route renders these as SSE frames."""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+
+TOPIC_BLOCK = "block"
+TOPIC_HEAD = "head"
+TOPIC_FINALIZED = "finalized_checkpoint"
+TOPIC_REORG = "chain_reorg"
+TOPIC_ATTESTATION = "attestation"
+
+ALL_TOPICS = (
+    TOPIC_BLOCK,
+    TOPIC_HEAD,
+    TOPIC_FINALIZED,
+    TOPIC_REORG,
+    TOPIC_ATTESTATION,
+)
+
+_QUEUE_CAP = 256
+
+
+def sse_frame(ev: dict) -> str:
+    """One event as an SSE wire frame — the single definition of the
+    format (shared by subscriptions and the http_api /events route)."""
+    return f"event: {ev['topic']}\ndata: {json.dumps(ev['data'])}\n\n"
+
+
+class EventSubscription:
+    """One consumer's bounded queue over a set of topics."""
+
+    def __init__(self, topics):
+        self.topics = frozenset(topics)
+        self._q: queue.Queue = queue.Queue(maxsize=_QUEUE_CAP)
+
+    def _offer(self, event: dict):
+        try:
+            self._q.put_nowait(event)
+        except queue.Full:
+            # lagging consumer: drop the oldest, keep the stream moving
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                self._q.put_nowait(event)
+            except queue.Full:
+                pass
+
+    def poll(self, timeout: float = 0.0) -> dict | None:
+        try:
+            return self._q.get(timeout=timeout) if timeout else self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def drain(self) -> list[dict]:
+        out = []
+        while True:
+            ev = self.poll()
+            if ev is None:
+                return out
+            out.append(ev)
+
+    def sse_frames(self, timeout: float = 0.0) -> str:
+        """Render pending events as SSE wire frames."""
+        return "".join(sse_frame(ev) for ev in self.drain())
+
+
+class ServerSentEventHandler:
+    def __init__(self):
+        self._subs: list[EventSubscription] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self, topics=ALL_TOPICS) -> EventSubscription:
+        bad = set(topics) - set(ALL_TOPICS)
+        if bad:
+            raise ValueError(f"unknown event topics: {sorted(bad)}")
+        sub = EventSubscription(topics)
+        with self._lock:
+            self._subs.append(sub)
+        return sub
+
+    def unsubscribe(self, sub: EventSubscription):
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    def _publish(self, topic: str, data: dict):
+        ev = {"topic": topic, "data": data}
+        with self._lock:
+            subs = list(self._subs)
+        for s in subs:
+            if topic in s.topics:
+                s._offer(ev)
+
+    # -- chain-facing emitters (events.rs register_* methods) -----------
+
+    def register_block(self, block_root: bytes, slot: int):
+        self._publish(
+            TOPIC_BLOCK,
+            {"slot": str(slot), "block": "0x" + block_root.hex()},
+        )
+
+    def register_head(self, head_root: bytes, slot: int, state_root: bytes):
+        self._publish(
+            TOPIC_HEAD,
+            {
+                "slot": str(slot),
+                "block": "0x" + head_root.hex(),
+                "state": "0x" + state_root.hex(),
+            },
+        )
+
+    def register_finalized(self, checkpoint):
+        self._publish(
+            TOPIC_FINALIZED,
+            {
+                "epoch": str(checkpoint.epoch),
+                "block": "0x" + bytes(checkpoint.root).hex(),
+            },
+        )
+
+    def register_reorg(self, old_head: bytes, new_head: bytes, slot: int, depth: int):
+        self._publish(
+            TOPIC_REORG,
+            {
+                "slot": str(slot),
+                "depth": str(depth),
+                "old_head_block": "0x" + old_head.hex(),
+                "new_head_block": "0x" + new_head.hex(),
+            },
+        )
+
+    def register_attestation(self, attestation):
+        d = attestation.data
+        self._publish(
+            TOPIC_ATTESTATION,
+            {
+                "slot": str(d.slot),
+                "index": str(d.index),
+                "beacon_block_root": "0x" + bytes(d.beacon_block_root).hex(),
+            },
+        )

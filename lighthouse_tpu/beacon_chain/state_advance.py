@@ -1,0 +1,73 @@
+"""Next-slot state pre-computation.
+
+The beacon_chain/src/state_advance_timer.rs analog (:1-15): shortly before
+each slot boundary the head state is advanced through the upcoming slot
+(epoch processing included — the expensive part at epoch boundaries) and
+cached, so block production and the first gossip verification of the new
+slot start from a pre-built state instead of paying the advance on the
+hot path. The chain's `_pre_state_for` consults the cache keyed by
+(parent_root, slot)."""
+
+from __future__ import annotations
+
+from ..metrics import start_timer
+from ..state_processing import per_slot_processing
+from ..utils.logging import get_logger
+
+log = get_logger("state_advance")
+
+
+class StateAdvanceCache:
+    """(head_root, slot) -> pre-advanced state. One entry — only the next
+    slot off the current head is worth keeping (state_advance_timer
+    advances at most 1 slot past the head for the same reason)."""
+
+    def __init__(self):
+        self._key: tuple[bytes, int] | None = None
+        self._state = None
+
+    def put(self, head_root: bytes, slot: int, state):
+        self._key = (head_root, slot)
+        self._state = state
+
+    def take(self, head_root: bytes, slot: int):
+        """Consume the cached state if it matches (single use — the caller
+        mutates it)."""
+        if self._key == (bytes(head_root), slot) and self._state is not None:
+            st = self._state
+            self._key = None
+            self._state = None
+            return st
+        return None
+
+
+class StateAdvanceTimer:
+    """Drives the pre-advance once per slot; call `on_slot_tick` from the
+    slot timer at the advance fraction (the reference fires at 3/4 into
+    the slot)."""
+
+    def __init__(self, chain):
+        self.chain = chain
+
+    def on_slot_tick(self, current_slot: int):
+        next_slot = current_slot + 1
+        head_root = self.chain.head_root
+        head_state = self.chain.head_state
+        if head_state.slot >= next_slot:
+            return  # head already at/past the target
+        if head_state.slot < current_slot:
+            # head is stale — this slot's block is likely still in flight
+            # (no local proposer), so a pre-advance keyed off the old head
+            # could never be consumed; skip instead of burning an epoch
+            # transition that no import will use
+            return
+        with start_timer("state_advance_seconds"):
+            state = head_state.copy()
+            while state.slot < next_slot:
+                per_slot_processing(state, self.chain.spec, self.chain.E)
+        self.chain.state_advance_cache.put(head_root, next_slot, state)
+        log.info(
+            "pre-advanced head state",
+            head=head_root.hex()[:12],
+            to_slot=next_slot,
+        )

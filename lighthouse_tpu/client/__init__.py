@@ -50,6 +50,7 @@ class Client:
         self.slot_clock = None
         self.executor = TaskExecutor(ShutdownSignal())
         self.keypairs = []
+        self.state_advance = None
 
     def start(self):
         if self.network is not None:
@@ -68,6 +69,9 @@ class Client:
             self.slot_clock.set_slot(slot)
         if self.vc is not None:
             self.vc.on_slot(slot)
+        if self.state_advance is not None:
+            # pre-build next slot's state off the (possibly new) head
+            self.state_advance.on_slot_tick(slot)
         set_gauge("beacon_head_slot", self.chain.head_state.slot)
 
     def stop(self):
@@ -154,7 +158,10 @@ class ClientBuilder:
             from ..validator_client import ValidatorClient
 
             c.vc = ValidatorClient(c.chain, c.keypairs, cfg.spec, cfg.E)
-        # timer
+        # timer + next-slot pre-advance (state_advance_timer.rs)
+        from ..beacon_chain.state_advance import StateAdvanceTimer
+
+        c.state_advance = StateAdvanceTimer(c.chain)
         c.timer = SlotTimer(c.slot_clock, c.on_slot, executor=c.executor)
         log.info(
             "client built",

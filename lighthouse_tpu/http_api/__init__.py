@@ -356,6 +356,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if path == "/eth/v1/events":
+                self._serve_events(parse_qs(parsed.query))
+                return
             m = re.match(r"^/eth/v2/beacon/blocks/(?P<block_id>[^/]+)$", path)
             if m:
                 if "application/octet-stream" in self.headers.get("Accept", ""):
@@ -402,6 +405,39 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json({"code": e.code, "message": e.message}, e.code)
         except Exception as e:  # noqa: BLE001
             self._send_json({"code": 500, "message": str(e)}, 500)
+
+    def _serve_events(self, query):
+        """SSE stream (beacon_chain/src/events.rs + the reference's
+        `events` warp route): subscribes to the chain's event handler for
+        the requested topics and streams frames until the client hangs up
+        (or `max_seconds`, a test convenience, elapses)."""
+        import time as _time
+
+        from ..beacon_chain.events import ALL_TOPICS, sse_frame
+
+        topics = query.get("topics", [",".join(ALL_TOPICS)])[0].split(",")
+        try:
+            sub = self.api.chain.event_handler.subscribe(topics)
+        except ValueError as e:
+            self._send_json({"code": 400, "message": str(e)}, 400)
+            return
+        max_seconds = float(query.get("max_seconds", ["3600"])[0])
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        deadline = _time.monotonic() + max_seconds
+        try:
+            while _time.monotonic() < deadline:
+                ev = sub.poll(timeout=0.25)
+                if ev is None:
+                    continue
+                self.wfile.write(sse_frame(ev).encode())
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away
+        finally:
+            self.api.chain.event_handler.unsubscribe(sub)
 
     def do_POST(self):
         inc_counter("http_api_requests_total", method="POST")

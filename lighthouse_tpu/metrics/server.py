@@ -24,8 +24,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path.split("?")[0] == "/metrics":
             # refresh host gauges at scrape time, as the reference's
-            # gather() does per scrape
-            observe_system_health()
+            # gather() does per scrape — into the registry being served
+            observe_system_health(self.registry)
             body = self.registry.expose().encode()
             self.send_response(200)
             self.send_header(

@@ -93,15 +93,20 @@ def system_health(path: str = "/") -> SystemHealth:
     )
 
 
-def observe_system_health():
-    """Publish the snapshot as gauges (scrape-time refresh)."""
+def observe_system_health(registry=None):
+    """Publish the snapshot as gauges (scrape-time refresh) into
+    `registry` (default: the process-global one)."""
     h = system_health()
-    set_gauge("system_total_memory_bytes", h.total_memory_bytes)
-    set_gauge("system_free_memory_bytes", h.free_memory_bytes)
-    set_gauge("system_loadavg_1", h.sys_loadavg_1)
-    set_gauge("system_cpu_cores", h.cpu_cores)
-    set_gauge("system_disk_bytes_total", h.disk_bytes_total)
-    set_gauge("system_disk_bytes_free", h.disk_bytes_free)
-    set_gauge("system_network_bytes_sent", h.network_bytes_sent)
-    set_gauge("system_network_bytes_received", h.network_bytes_received)
+    if registry is None:
+        setter = set_gauge
+    else:
+        setter = lambda name, v: registry.gauge(name).set(v)  # noqa: E731
+    setter("system_total_memory_bytes", h.total_memory_bytes)
+    setter("system_free_memory_bytes", h.free_memory_bytes)
+    setter("system_loadavg_1", h.sys_loadavg_1)
+    setter("system_cpu_cores", h.cpu_cores)
+    setter("system_disk_bytes_total", h.disk_bytes_total)
+    setter("system_disk_bytes_free", h.disk_bytes_free)
+    setter("system_network_bytes_sent", h.network_bytes_sent)
+    setter("system_network_bytes_received", h.network_bytes_received)
     return h

@@ -42,6 +42,7 @@ SCORE_INVALID_MESSAGE = -10.0
 SCORE_TIMELY_MESSAGE = 0.5
 BAN_THRESHOLD = -40.0
 MAX_SCORE = 100.0
+BAN_DURATION = 3600.0  # bans expire (peerdb's ban period); entry then drops
 _GOSSIP_IO_TIMEOUT = 30.0  # bounds send stalls AND idle reader probes
 
 
@@ -53,6 +54,7 @@ class Peer:
     status: M.StatusMessage | None = None
     score: float = 0.0
     banned: bool = False
+    banned_at: float = 0.0
     gossip_sock: socket.socket | None = None
     lock: threading.Lock = field(default_factory=threading.Lock)
 
@@ -77,7 +79,12 @@ class PeerManager:
             existing = self._peers.get(peer.peer_id)
             if existing is not None:
                 if existing.banned:
-                    return False
+                    if time.monotonic() - existing.banned_at < BAN_DURATION:
+                        return False
+                    # expired ban: the identity starts fresh
+                    self._peers.pop(peer.peer_id)
+                    existing = None
+            if existing is not None:
                 peer.score = existing.score
                 with existing.lock:
                     stale_sock = existing.gossip_sock
@@ -95,7 +102,14 @@ class PeerManager:
     def is_banned(self, peer_id: str) -> bool:
         with self._lock:
             p = self._peers.get(peer_id)
-            return p is not None and p.banned
+            if p is None or not p.banned:
+                return False
+            if time.monotonic() - p.banned_at >= BAN_DURATION:
+                # ban served: drop the dead entry entirely (bounds the
+                # table — banned identities don't accumulate forever)
+                self._peers.pop(peer_id, None)
+                return False
+            return True
 
     def _gauge_count(self) -> int:
         """Connected (non-banned) peers — call under self._lock."""
@@ -128,6 +142,7 @@ class PeerManager:
             p.score = min(MAX_SCORE, p.score + delta)
             if p.score <= BAN_THRESHOLD and not p.banned:
                 p.banned = True
+                p.banned_at = time.monotonic()
                 newly_banned = p
                 inc_counter("network_peers_banned_total")
             n = self._gauge_count()

@@ -110,6 +110,11 @@ class HotColdDB:
             return None
         return self._decode(data, "SignedBeaconBlock")
 
+    def delete_block(self, block_root: bytes):
+        """Hot-only deletion (fork_revert wipes unfinalized segments;
+        cold blocks are finalized and must never be deleted)."""
+        self.hot.delete(DBColumn.BEACON_BLOCK, block_root)
+
     def block_exists(self, block_root: bytes) -> bool:
         return self.hot.exists(DBColumn.BEACON_BLOCK, block_root) or self.cold.exists(
             DBColumn.BEACON_BLOCK, block_root
