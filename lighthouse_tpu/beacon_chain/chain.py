@@ -113,6 +113,11 @@ class BeaconChain:
         self.invalid_block_roots: set[bytes] = set()
         self._last_finalized_epoch_seen = 0
 
+        # tree-states: registry-scale uint64 lists become persistent
+        # (structurally-shared, block-hash-cached) for the whole chain
+        # lineage — copies/upgrades preserve the type (milhouse analog)
+        _make_persistent(genesis_state)
+
         genesis_root = _genesis_block_root(genesis_state, self.types)
         self.genesis_block_root = genesis_root
         self.genesis_validators_root = genesis_state.genesis_validators_root
@@ -280,9 +285,13 @@ class BeaconChain:
         if signed is None:
             return None
         state = self.store.get_state(signed.message.state_root)
+        if state is None:
+            state = self._replay_state(block_root)
         if state is not None:
-            return state
-        return self._replay_state(block_root)
+            # SSZ deserialization yields plain lists — restore the
+            # tree-states persistence for the lineage built from here
+            _make_persistent(state)
+        return state
 
     def _signed_block(self, block_root: bytes):
         blk = self._blocks_by_root.get(block_root)
@@ -759,6 +768,16 @@ class BeaconChain:
         else:
             parent_hash = None
         return self.execution_layer.get_payload(parent_hash, attributes, fork)
+
+
+def _make_persistent(state):
+    """Swap big uint64 list fields to PersistentList in place."""
+    from ..ssz.persistent import PersistentList
+
+    for fname in ("balances", "inactivity_scores"):
+        v = getattr(state, fname, None)
+        if isinstance(v, list):
+            object.__setattr__(state, fname, PersistentList(v))
 
 
 def empty_sync_aggregate(types, E):

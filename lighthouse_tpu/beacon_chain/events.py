@@ -72,8 +72,15 @@ class EventSubscription:
             out.append(ev)
 
     def sse_frames(self, timeout: float = 0.0) -> str:
-        """Render pending events as SSE wire frames."""
-        return "".join(sse_frame(ev) for ev in self.drain())
+        """Render pending events as SSE wire frames; with a timeout, block
+        up to that long for the first event."""
+        out = []
+        if timeout:
+            ev = self.poll(timeout=timeout)
+            if ev is not None:
+                out.append(sse_frame(ev))
+        out.extend(sse_frame(ev) for ev in self.drain())
+        return "".join(out)
 
 
 class ServerSentEventHandler:

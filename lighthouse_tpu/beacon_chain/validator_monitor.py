@@ -83,6 +83,8 @@ class ValidatorMonitor:
                 continue
             bits = att.aggregation_bits
             for pos, vi in enumerate(committee):
+                if pos < len(bits) and bits[pos] and self.auto_register:
+                    self.add_validator(vi)  # --validator-monitor-auto
                 if pos < len(bits) and bits[pos] and vi in self._by_index:
                     mv = self._by_index[vi]
                     delay = max(1, block.slot - data.slot)

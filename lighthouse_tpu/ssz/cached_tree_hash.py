@@ -239,12 +239,21 @@ class BeaconStateHashCache:
         """Cached root for `fname`, or None if the field isn't cacheable."""
         ent = self.LIST_FIELDS.get(fname)
         if ent is not None and hasattr(state, fname):
-            extract, _ = ent
             from .merkle import mix_in_length
 
+            value = getattr(state, fname)
+            from .persistent import PersistentList
+
+            if isinstance(value, PersistentList):
+                # the list carries its own block-memoized cache (shared
+                # across state copies) — strictly better than re-packing
+                return mix_in_length(
+                    value.hash_tree_root(ftype.chunk_count()), len(value)
+                )
+            extract, _ = ent
             cache = self._cache_for(fname, ftype)
             root = cache.update(extract(state, None))
-            return mix_in_length(root, len(getattr(state, fname)))
+            return mix_in_length(root, len(value))
         ext = self.VECTOR_FIELDS.get(fname)
         if ext is not None and hasattr(state, fname):
             cache = self._cache_for(fname, ftype)

@@ -26,6 +26,7 @@ from .merkle import (
     mix_in_selector,
     pack_bytes,
 )
+from .persistent import PersistentList
 
 BYTES_PER_LENGTH_OFFSET = 4
 
@@ -495,7 +496,11 @@ class List(SSZType):
 
     @classmethod
     def hash_tree_root_of(cls, value) -> bytes:
-        root = merkleize(_chunks_of(cls.ELEM, value), limit=cls.chunk_count())
+        if isinstance(value, PersistentList):
+            # structural-sharing fast path: block-memoized subtree roots
+            root = value.hash_tree_root(cls.chunk_count())
+        else:
+            root = merkleize(_chunks_of(cls.ELEM, value), limit=cls.chunk_count())
         return mix_in_length(root, len(value))
 
     @classmethod
@@ -504,6 +509,15 @@ class List(SSZType):
 
     @classmethod
     def coerce(cls, value):
+        if isinstance(value, PersistentList):
+            # already element-validated; keep the shared structure
+            if cls.ELEM is not uint64:
+                raise ValueError("PersistentList fields must be uint64 lists")
+            if len(value) > cls.LIMIT:
+                raise ValueError(
+                    f"List limit {cls.LIMIT} exceeded: {len(value)}"
+                )
+            return value
         vals = [cls.ELEM.coerce(v) for v in value]
         if len(vals) > cls.LIMIT:
             raise ValueError(f"List limit {cls.LIMIT} exceeded: {len(vals)}")
@@ -880,6 +894,8 @@ class Container(SSZType, metaclass=_ContainerMeta):
 def _deep_copy(ftype, value):
     if isinstance(value, Container):
         return value.copy()
+    if isinstance(value, PersistentList):
+        return value.copy()  # O(#blocks) structural share
     if isinstance(value, bytearray):
         return bytearray(value)
     if isinstance(value, list):

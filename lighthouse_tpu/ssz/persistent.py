@@ -1,0 +1,232 @@
+"""Persistent (structurally-shared) uint64 list with internal hash caching.
+
+The milhouse analog (the "tree-states" backbone: reference
+consensus/types/src/beacon_state.rs:34,371 stores `validators`/`balances`
+as milhouse `List`s with structural sharing + internal hash caches).
+Re-designed for this framework's flat-array style instead of milhouse's
+pointer tree:
+
+- elements live in fixed-size blocks (4096 × uint64 = 1024 SSZ chunks =
+  a depth-10 subtree), so block boundaries align with Merkle subtrees;
+- `copy()` is O(#blocks): both lists drop in-place ownership and share
+  the block objects (copy-on-write — a mutation clones only its block);
+- every block memoizes its subtree root, so `hash_tree_root()` after k
+  mutated blocks costs k block-rebuilds + one fold over #block roots —
+  the structural-sharing half of what `cached_tree_hash` does for
+  monolithic arrays, but carried across state copies for free.
+
+Supports the exact mutation surface the state transition uses on
+balances/inactivity_scores: indexing, slice read/assign, `append`,
+iteration, `len`, equality (accessors.py:263-267, altair.py:559-562,
+per_block.py:653, per_epoch.py:440)."""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..utils.hash import ZERO_HASHES, hash32_concat
+
+BLOCK_ELEMS = 4096  # uint64 elements per block
+_CHUNKS_PER_BLOCK = BLOCK_ELEMS * 8 // 32  # 1024
+_BLOCK_DEPTH = (_CHUNKS_PER_BLOCK - 1).bit_length()  # 10
+
+_U64_MAX = (1 << 64) - 1
+
+
+class _Block:
+    __slots__ = ("items", "root")
+
+    def __init__(self, items: list[int]):
+        self.items = items
+        self.root: bytes | None = None
+
+    def subtree_root(self) -> bytes:
+        """Root of this block's depth-10 subtree (zero-padded)."""
+        if self.root is None:
+            data = b"".join(v.to_bytes(8, "little") for v in self.items)
+            # pad to whole chunks; absent chunks fold in as ZERO_HASHES
+            if len(data) % 32:
+                data += b"\x00" * (32 - len(data) % 32)
+            nodes = [data[i : i + 32] for i in range(0, len(data), 32)]
+            if not nodes:
+                nodes = [ZERO_HASHES[0]]
+            level = 0
+            while level < _BLOCK_DEPTH:
+                if len(nodes) % 2:
+                    nodes.append(ZERO_HASHES[level])
+                nodes = [
+                    hashlib.sha256(nodes[i] + nodes[i + 1]).digest()
+                    for i in range(0, len(nodes), 2)
+                ]
+                level += 1
+            self.root = nodes[0]
+        return self.root
+
+
+class PersistentList:
+    __slots__ = ("_blocks", "_owned")
+
+    def __init__(self, values=()):
+        vals = [self._coerce(v) for v in values]
+        self._blocks = [
+            _Block(vals[i : i + BLOCK_ELEMS])
+            for i in range(0, len(vals), BLOCK_ELEMS)
+        ]
+        self._owned = [True] * len(self._blocks)
+
+    @staticmethod
+    def _coerce(v) -> int:
+        v = int(v)
+        if not 0 <= v <= _U64_MAX:
+            raise ValueError(f"uint64 out of range: {v}")
+        return v
+
+    # -- structural sharing ---------------------------------------------
+
+    def copy(self) -> "PersistentList":
+        """O(#blocks): share every block; neither side may mutate a
+        shared block in place afterwards (copy-on-write)."""
+        out = PersistentList.__new__(PersistentList)
+        out._blocks = list(self._blocks)
+        out._owned = [False] * len(self._blocks)
+        self._owned = [False] * len(self._blocks)
+        return out
+
+    def _own(self, bi: int) -> _Block:
+        """Block bi, cloned first if shared (the CoW write barrier)."""
+        blk = self._blocks[bi]
+        if not self._owned[bi]:
+            blk = _Block(list(blk.items))
+            self._blocks[bi] = blk
+            self._owned[bi] = True
+        blk.root = None
+        return blk
+
+    def shared_block_count(self, other: "PersistentList") -> int:
+        """How many blocks two lists share (introspection for tests)."""
+        mine = {id(b) for b in self._blocks}
+        return sum(1 for b in other._blocks if id(b) in mine)
+
+    # -- list surface ----------------------------------------------------
+
+    def __len__(self) -> int:
+        if not self._blocks:
+            return 0
+        return (len(self._blocks) - 1) * BLOCK_ELEMS + len(
+            self._blocks[-1].items
+        )
+
+    def __iter__(self):
+        for blk in self._blocks:
+            yield from blk.items
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self)[idx]
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError(idx)
+        return self._blocks[idx // BLOCK_ELEMS].items[idx % BLOCK_ELEMS]
+
+    def __setitem__(self, idx, value):
+        if isinstance(idx, slice):
+            self._assign_slice(idx, value)
+            return
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError(idx)
+        v = self._coerce(value)
+        bi, off = divmod(idx, BLOCK_ELEMS)
+        if self._blocks[bi].items[off] != v:
+            self._own(bi).items[off] = v
+
+    def _assign_slice(self, sl: slice, values):
+        n = len(self)
+        start, stop, step = sl.indices(n)
+        vals = [self._coerce(v) for v in values]
+        if step != 1 or (stop - start) != len(vals):
+            # general path: rare in consensus code; rebuild
+            all_vals = list(self)
+            all_vals[sl] = vals
+            fresh = PersistentList(all_vals)
+            self._blocks = fresh._blocks
+            self._owned = fresh._owned
+            return
+        # contiguous same-length assignment (the epoch sweep's
+        # `balances[:] = ...`): touch only blocks whose contents change,
+        # preserving the root memos of untouched shared blocks
+        i = start
+        vi = 0
+        while i < stop:
+            bi, off = divmod(i, BLOCK_ELEMS)
+            blk = self._blocks[bi]
+            span = min(len(blk.items) - off, stop - i)
+            new = vals[vi : vi + span]
+            if blk.items[off : off + span] != new:
+                self._own(bi).items[off : off + span] = new
+            i += span
+            vi += span
+
+    def append(self, value):
+        v = self._coerce(value)
+        if self._blocks and len(self._blocks[-1].items) < BLOCK_ELEMS:
+            self._own(len(self._blocks) - 1).items.append(v)
+        else:
+            self._blocks.append(_Block([v]))
+            self._owned.append(True)
+
+    def __eq__(self, other):
+        if isinstance(other, (PersistentList, list, tuple)):
+            return len(self) == len(other) and all(
+                a == b for a, b in zip(self, other)
+            )
+        return NotImplemented
+
+    def __repr__(self):
+        n = len(self)
+        head = ", ".join(str(v) for v in self[: min(4, n)])
+        return f"PersistentList(len={n}, [{head}{', …' if n > 4 else ''}])"
+
+    # -- hashing ----------------------------------------------------------
+
+    def hash_tree_root(self, limit_chunks: int) -> bytes:
+        """Merkle root over the list's chunks zero-extended to
+        `limit_chunks` (no length mix — the SSZ List type mixes it). Cost:
+        re-hash of dirty blocks + a fold over #blocks."""
+        total_depth = (limit_chunks - 1).bit_length() if limit_chunks > 1 else 0
+        if total_depth < _BLOCK_DEPTH:
+            # list type smaller than one block: the depth-10 block memo
+            # frame doesn't apply — fold the chunks at the type's true
+            # depth (clamping to _BLOCK_DEPTH here would silently produce
+            # a non-SSZ root)
+            data = b"".join(v.to_bytes(8, "little") for v in self)
+            if len(data) % 32:
+                data += b"\x00" * (32 - len(data) % 32)
+            nodes = [data[i : i + 32] for i in range(0, len(data), 32)] or [
+                ZERO_HASHES[0]
+            ]
+            for level in range(total_depth):
+                if len(nodes) % 2:
+                    nodes.append(ZERO_HASHES[level])
+                nodes = [
+                    hash32_concat(nodes[i], nodes[i + 1])
+                    for i in range(0, len(nodes), 2)
+                ]
+            return nodes[0]
+        roots = [blk.subtree_root() for blk in self._blocks]
+        if not roots:
+            roots = [ZERO_HASHES[_BLOCK_DEPTH]]
+        level = _BLOCK_DEPTH
+        while level < total_depth:
+            if len(roots) % 2:
+                roots.append(ZERO_HASHES[level])
+            roots = [
+                hash32_concat(roots[i], roots[i + 1])
+                for i in range(0, len(roots), 2)
+            ]
+            level += 1
+        return roots[0]

@@ -18,6 +18,16 @@ from .altair import (
 )
 
 
+def _persistent_like(template, values):
+    """Match the persistence of an existing field: a chain whose balances
+    ride PersistentList gets new registry-scale lists the same way."""
+    from ..ssz.persistent import PersistentList
+
+    if isinstance(template, PersistentList):
+        return PersistentList(values)
+    return values
+
+
 def _swap_class(state, new_cls, new_field_values: dict):
     """Re-class `state` to the next fork variant; new fields are coerced by
     the container's field machinery."""
@@ -74,7 +84,8 @@ def upgrade_to_altair(state, spec: ChainSpec, E):
         dict(
             previous_epoch_participation=[0] * n,
             current_epoch_participation=[0] * n,
-            inactivity_scores=[0] * n,
+            # stays structurally-shared across copies if balances already is
+            inactivity_scores=_persistent_like(state.balances, [0] * n),
             current_sync_committee=t.SyncCommittee.default(),
             next_sync_committee=t.SyncCommittee.default(),
         ),

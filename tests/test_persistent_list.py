@@ -1,0 +1,126 @@
+"""PersistentList: structural sharing + internal hash caching.
+
+The milhouse analog (reference consensus/types/src/beacon_state.rs:34,371
+stores validators/balances as structurally-shared hash-caching lists)."""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.ssz.core import List, uint64
+from lighthouse_tpu.ssz.persistent import BLOCK_ELEMS, PersistentList
+
+
+def test_list_surface_matches_plain_list():
+    vals = list(range(10_000))
+    p = PersistentList(vals)
+    assert len(p) == 10_000
+    assert p[0] == 0 and p[9_999] == 9_999 and p[-1] == 9_999
+    assert list(p) == vals
+    assert p == vals
+    p[5] = 42
+    assert p[5] == 42
+    p.append(77)
+    assert len(p) == 10_001 and p[-1] == 77
+    assert p[100:103] == [100, 101, 102]
+    with pytest.raises(IndexError):
+        p[10_001]
+    with pytest.raises(ValueError):
+        p[0] = -1
+
+
+def test_copy_shares_blocks_and_cow_isolates():
+    p = PersistentList(range(3 * BLOCK_ELEMS))
+    c = p.copy()
+    assert p.shared_block_count(c) == 3
+    c[0] = 999  # clones only block 0 of the copy
+    assert p[0] == 0 and c[0] == 999
+    assert p.shared_block_count(c) == 2
+    # mutating the ORIGINAL after copy must not leak into the copy either
+    p[2 * BLOCK_ELEMS] = 123
+    assert c[2 * BLOCK_ELEMS] == 2 * BLOCK_ELEMS
+    assert p.shared_block_count(c) == 1
+
+
+def test_hash_tree_root_matches_reference_merkleization():
+    T = List[uint64, 1 << 40]
+    for n in (0, 1, 5, BLOCK_ELEMS, BLOCK_ELEMS + 3, 2 * BLOCK_ELEMS + 17):
+        vals = [(i * 7919) % (1 << 60) for i in range(n)]
+        p = PersistentList(vals)
+        assert T.hash_tree_root_of(p) == T.hash_tree_root_of(vals), n
+
+
+def test_hash_tree_root_small_limit_types():
+    """Lists whose chunk limit is below one block (e.g. attesting-indices
+    shapes) must still produce the exact SSZ root — regression for the
+    depth-clamping bug."""
+    for limit in (8, 64, 2048, 16384):
+        T = List[uint64, limit * 4]  # limit*4 elems = `limit` chunks
+        for n in (0, 1, 3, 7):
+            vals = list(range(100, 100 + n))
+            assert T.hash_tree_root_of(PersistentList(vals)) == T.hash_tree_root_of(
+                vals
+            ), (limit, n)
+
+
+def test_hash_cache_reuse_across_copies():
+    T = List[uint64, 1 << 40]
+    n = 64 * BLOCK_ELEMS  # 262k elements
+    p = PersistentList(range(n))
+    t0 = time.perf_counter()
+    r1 = T.hash_tree_root_of(p)
+    cold = time.perf_counter() - t0
+
+    c = p.copy()
+    c[0] = 1  # dirty exactly one block
+    t0 = time.perf_counter()
+    r2 = T.hash_tree_root_of(c)
+    warm = time.perf_counter() - t0
+    assert r2 != r1
+    assert T.hash_tree_root_of(c) == T.hash_tree_root_of(list(c))
+    # one dirty block out of 64: the memoized rebuild must be much
+    # cheaper than the cold full build (conservative 5x bound)
+    assert warm < cold / 5, (cold, warm)
+    # and the ORIGINAL's memos survived its copy untouched
+    t0 = time.perf_counter()
+    assert T.hash_tree_root_of(p) == r1
+    assert time.perf_counter() - t0 < cold / 20
+
+
+def test_slice_assign_preserves_untouched_block_memos():
+    T = List[uint64, 1 << 40]
+    n = 8 * BLOCK_ELEMS
+    p = PersistentList([5] * n)
+    T.hash_tree_root_of(p)  # build memos
+    new = [5] * n
+    new[3 * BLOCK_ELEMS + 1] = 6  # change lands in block 3 only
+    p[:] = new
+    dirty = [i for i, b in enumerate(p._blocks) if b.root is None]
+    assert dirty == [3]
+    assert T.hash_tree_root_of(p) == T.hash_tree_root_of(new)
+
+
+def test_chain_states_share_balance_blocks_across_copies():
+    """End-to-end: a harness chain's states carry PersistentList balances
+    and copies share blocks (the tree-states capability on the real
+    BeaconState path)."""
+    from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    prev = bls.backend_name()
+    bls.set_backend("fake_crypto")
+    try:
+        h = BeaconChainHarness(minimal_spec(), E, validator_count=16)
+        assert isinstance(h.chain.head_state.balances, PersistentList)
+        h.extend_chain(E.SLOTS_PER_EPOCH + 2)
+        assert isinstance(h.chain.head_state.balances, PersistentList)
+        # serialization still round-trips through the plain SSZ path
+        st = h.chain.head_state
+        data = st.serialize()
+        rt = type(st).deserialize(data)
+        assert list(rt.balances) == list(st.balances)
+        assert rt.hash_tree_root() == st.hash_tree_root()
+    finally:
+        bls.set_backend(prev)
