@@ -112,6 +112,12 @@ class BeaconChain:
         self.state_advance_cache = StateAdvanceCache()
         self.invalid_block_roots: set[bytes] = set()
         self._last_finalized_epoch_seen = 0
+        # gossip reader threads, the VC, and sync all mutate the chain
+        # concurrently; imports serialize on a loud-failure lock
+        # (timeout_rw_lock.rs — starvation raises instead of deadlocking)
+        from ..utils.timeout_lock import TimeoutRwLock
+
+        self.import_lock = TimeoutRwLock("chain_import", timeout=30.0)
 
         # tree-states: registry-scale uint64 lists become persistent
         # (structurally-shared, block-hash-cached) for the whole chain
@@ -420,19 +426,34 @@ class BeaconChain:
             per_slot_processing(state, self.spec, self.E)
         return state
 
-    def process_block(self, block_input) -> bytes:
+    def process_block(
+        self,
+        block_input,
+        segment_verified_roots=None,
+        precomputed_post_state=None,
+    ) -> bytes:
         """Full import (beacon_chain.rs:3035 process_block → :3362
         import_block): state transition with bulk signature verification,
         store write, fork-choice registration (block + its attestations),
-        head recompute."""
+        head recompute. `segment_verified_roots` marks blocks whose
+        signatures were already covered by a segment-wide batch;
+        `precomputed_post_state` is the root-checked post-state from the
+        segment replay (skips the second transition)."""
         from ..metrics import inc_counter, start_timer
 
-        with start_timer("beacon_block_import_seconds"):
-            root = self._process_block_inner(block_input)
+        with self.import_lock.acquire_write():
+            with start_timer("beacon_block_import_seconds"):
+                root = self._process_block_inner(
+                    block_input,
+                    segment_verified_roots or (),
+                    precomputed_post_state,
+                )
         inc_counter("beacon_blocks_imported_total")
         return root
 
-    def _process_block_inner(self, block_input) -> bytes:
+    def _process_block_inner(
+        self, block_input, segment_verified_roots=(), precomputed_post_state=None
+    ) -> bytes:
         pre_state = None
         if isinstance(block_input, GossipVerifiedBlock):
             signed_block = block_input.signed_block
@@ -479,22 +500,37 @@ class BeaconChain:
                     "blobs unavailable: feed sidecars via process_blob_sidecars"
                 )
 
-        state = pre_state if pre_state is not None else self._pre_state_for(block)
         ctxt = ConsensusContext(block.slot)
-        try:
-            per_block_processing(
-                state,
-                signed_block,
-                self.spec,
-                self.E,
-                strategy=BlockSignatureStrategy.VERIFY_BULK,
-                ctxt=ctxt,
-                block_root=block_root,
-                proposal_already_verified=proposal_verified,
-                execution_engine=self.execution_layer,
+        if (
+            precomputed_post_state is not None
+            and block_root in segment_verified_roots
+        ):
+            # segment path: signatures batch-verified, transition already
+            # run (state root checked) and EL notified during the replay
+            state = precomputed_post_state
+        else:
+            state = (
+                pre_state if pre_state is not None else self._pre_state_for(block)
             )
-        except BlockProcessingError as e:
-            raise BlockError(f"invalid block: {e}") from e
+            strategy = (
+                BlockSignatureStrategy.NO_VERIFICATION
+                if block_root in segment_verified_roots
+                else BlockSignatureStrategy.VERIFY_BULK
+            )
+            try:
+                per_block_processing(
+                    state,
+                    signed_block,
+                    self.spec,
+                    self.E,
+                    strategy=strategy,
+                    ctxt=ctxt,
+                    block_root=block_root,
+                    proposal_already_verified=proposal_verified,
+                    execution_engine=self.execution_layer,
+                )
+            except BlockProcessingError as e:
+                raise BlockError(f"invalid block: {e}") from e
 
         # import_block: store + fork choice + head
         is_timely = (
@@ -538,18 +574,93 @@ class BeaconChain:
         return block_root
 
     def process_chain_segment(self, blocks) -> ChainSegmentResult:
-        """Range-sync import: one bulk signature batch across all blocks
-        would mirror signature_verify_chain_segment (block_verification.rs:
-        568); blocks are applied sequentially with per-block bulk batches
-        for now."""
+        """Range-sync import (beacon_chain.rs:2750): ONE bulk signature
+        batch across every signature in every block of the segment
+        (signature_verify_chain_segment, block_verification.rs:568), then
+        sequential signature-free imports. A failed batch rejects the
+        whole segment before anything touches fork choice."""
+        blocks = list(blocks)
+        verified_roots: set[bytes] = set()
+        post_states: dict[bytes, object] = {}
+        if len(blocks) > 1:
+            try:
+                verified_roots, post_states = (
+                    self._signature_verify_chain_segment(blocks)
+                )
+            except BlockError as e:
+                return ChainSegmentResult(imported=0, error=e)
         imported = 0
         for signed_block in blocks:
             try:
-                self.process_block(signed_block)
+                root = signed_block.message.hash_tree_root()
+                self.process_block(
+                    signed_block,
+                    segment_verified_roots=verified_roots,
+                    precomputed_post_state=post_states.get(root),
+                )
                 imported += 1
             except BlockError as e:
                 return ChainSegmentResult(imported=imported, error=e)
         return ChainSegmentResult(imported=imported)
+
+    def _signature_verify_chain_segment(self, blocks) -> set[bytes]:
+        """Collect every signature set across the segment against the
+        correct per-block pre-states and verify them as ONE batch. The
+        committee/proposer states are obtained by replaying the segment
+        with NO_VERIFICATION (randao mixes from earlier segment blocks
+        seed later blocks' committees, so slot-advance alone is not
+        enough across epoch boundaries). The replayed post-states are kept
+        and handed to the import loop, so each block's transition (and EL
+        notify) runs exactly once. Returns (verified roots, post-states)."""
+        from ..crypto import bls
+        from ..state_processing.per_block import BlockSignatureVerifier
+
+        first_parent = bytes(blocks[0].message.parent_root)
+        # chain-state reads (fork choice, snapshot cache, store) race with
+        # concurrent imports pruning at finality — hold the read lock
+        with self.import_lock.acquire_read():
+            if not self.fork_choice.contains_block(first_parent):
+                raise BlockError("parent unknown")
+            parent_state = self._states.get(
+                first_parent
+            ) or self._load_state_for_block(first_parent)
+            if parent_state is None:
+                raise BlockError("no state for segment parent")
+            state = parent_state.copy()
+        sets = []
+        roots = set()
+        post_states: dict[bytes, object] = {}
+        for signed in blocks:
+            block = signed.message
+            if bytes(block.parent_root) not in roots | {first_parent}:
+                raise BlockError("segment blocks are not a chain")
+            while state.slot < block.slot:
+                per_slot_processing(state, self.spec, self.E)
+            block_root = block.hash_tree_root()
+            ctxt = ConsensusContext(block.slot)
+            verifier = BlockSignatureVerifier(state, self.spec, self.E)
+            try:
+                verifier.include_all_signatures(signed, block_root, ctxt)
+            except (BlockProcessingError, IndexError, KeyError, ValueError) as e:
+                raise BlockError(f"segment signature collection: {e}") from e
+            sets.extend(verifier.sets)
+            try:
+                per_block_processing(
+                    state,
+                    signed,
+                    self.spec,
+                    self.E,
+                    strategy=BlockSignatureStrategy.NO_VERIFICATION,
+                    ctxt=ctxt,
+                    execution_engine=self.execution_layer,
+                )
+            except BlockProcessingError as e:
+                raise BlockError(f"invalid segment block: {e}") from e
+            roots.add(block_root)
+            post_states[block_root] = state.copy()
+        if sets and not bls.verify_signature_sets(sets):
+            raise BlockError("segment bulk signature verification failed")
+        return roots, post_states
 
     def _prune_at_finality(self):
         """Drop snapshot-cache states that can no longer become head, and
@@ -609,8 +720,9 @@ class BeaconChain:
     def process_attestation(self, attestation):
         """Verify a gossip attestation, feed fork choice + op pool."""
         verified = self.attestation_verifier.verify_unaggregated(attestation)
-        self.apply_attestation_to_fork_choice(verified.indexed_attestation)
-        self.op_pool.insert_attestation(attestation)
+        with self.import_lock.acquire_write():
+            self.apply_attestation_to_fork_choice(verified.indexed_attestation)
+            self.op_pool.insert_attestation(attestation)
         self.event_handler.register_attestation(attestation)
         return verified
 
@@ -630,16 +742,22 @@ class BeaconChain:
         results = self.attestation_verifier.batch_verify_unaggregated(
             attestations
         )
-        for att, res in zip(attestations, results):
-            if not isinstance(res, Exception):
-                self.apply_attestation_to_fork_choice(res.indexed_attestation)
-                self.op_pool.insert_attestation(att)
+        with self.import_lock.acquire_write():
+            for att, res in zip(attestations, results):
+                if not isinstance(res, Exception):
+                    self.apply_attestation_to_fork_choice(
+                        res.indexed_attestation
+                    )
+                    self.op_pool.insert_attestation(att)
         return results
 
     def process_aggregate(self, signed_aggregate):
         verified = self.attestation_verifier.verify_aggregated(signed_aggregate)
-        self.apply_attestation_to_fork_choice(verified.indexed_attestation)
-        self.op_pool.insert_attestation(signed_aggregate.message.aggregate)
+        with self.import_lock.acquire_write():
+            self.apply_attestation_to_fork_choice(verified.indexed_attestation)
+            self.op_pool.insert_attestation(
+                signed_aggregate.message.aggregate
+            )
         return verified
 
     def apply_attestation_to_fork_choice(self, indexed):

@@ -194,11 +194,43 @@ class TrustedSetup:
             pass
         return cls(g1, g2, n)
 
+    #: the packaged public KZG ceremony output (ethereum/kzg-ceremony —
+    #: pure spec data, byte-identical across every consensus client)
+    CEREMONY_SEARCH_PATHS = (
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "trusted_setup.json"
+        ),
+    )
+
     @classmethod
     def default(cls) -> "TrustedSetup":
+        """Resolution order: LIGHTHOUSE_TPU_TRUSTED_SETUP env var, the
+        packaged mainnet ceremony file, then (loudly) the insecure dev
+        setup — never silently, since the choice is consensus-critical."""
+        from ...utils.logging import get_logger
+
+        log = get_logger("kzg")
         path = os.environ.get("LIGHTHOUSE_TPU_TRUSTED_SETUP")
         if path:
+            log.info("trusted setup from env", path=path)
             return cls.from_json(path)
+        for candidate in cls.CEREMONY_SEARCH_PATHS:
+            if os.path.exists(candidate):
+                try:
+                    setup = cls.from_json(candidate)
+                except (OSError, KzgError, ValueError) as e:
+                    log.warning(
+                        "malformed trusted setup; skipping",
+                        path=candidate,
+                        error=repr(e),
+                    )
+                    continue
+                log.info("trusted setup: mainnet ceremony", path=candidate)
+                return setup
+        log.warning(
+            "NO ceremony file found — using the INSECURE dev setup "
+            "(fine for tests, never for mainnet)"
+        )
         return cls.insecure_dev()
 
 

@@ -510,14 +510,16 @@ class List(SSZType):
     @classmethod
     def coerce(cls, value):
         if isinstance(value, PersistentList):
-            # already element-validated; keep the shared structure
+            # already element-validated; share blocks but never alias the
+            # caller's object (plain-list coerce copies for the same reason
+            # — without copy() there is no CoW barrier between the two)
             if cls.ELEM is not uint64:
                 raise ValueError("PersistentList fields must be uint64 lists")
             if len(value) > cls.LIMIT:
                 raise ValueError(
                     f"List limit {cls.LIMIT} exceeded: {len(value)}"
                 )
-            return value
+            return value.copy()
         vals = [cls.ELEM.coerce(v) for v in value]
         if len(vals) > cls.LIMIT:
             raise ValueError(f"List limit {cls.LIMIT} exceeded: {len(vals)}")

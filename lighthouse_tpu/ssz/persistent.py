@@ -33,6 +33,26 @@ _BLOCK_DEPTH = (_CHUNKS_PER_BLOCK - 1).bit_length()  # 10
 _U64_MAX = (1 << 64) - 1
 
 
+def _fold_values(values, depth: int) -> bytes:
+    """Pack uint64s into 32-byte chunks and fold to a subtree root at
+    `depth`, zero-padding absent chunks — the ONE definition of this
+    Merkleization (block memos and sub-block list types both use it)."""
+    data = b"".join(v.to_bytes(8, "little") for v in values)
+    if len(data) % 32:
+        data += b"\x00" * (32 - len(data) % 32)
+    nodes = [data[i : i + 32] for i in range(0, len(data), 32)] or [
+        ZERO_HASHES[0]
+    ]
+    for level in range(depth):
+        if len(nodes) % 2:
+            nodes.append(ZERO_HASHES[level])
+        nodes = [
+            hashlib.sha256(nodes[i] + nodes[i + 1]).digest()
+            for i in range(0, len(nodes), 2)
+        ]
+    return nodes[0]
+
+
 class _Block:
     __slots__ = ("items", "root")
 
@@ -43,23 +63,7 @@ class _Block:
     def subtree_root(self) -> bytes:
         """Root of this block's depth-10 subtree (zero-padded)."""
         if self.root is None:
-            data = b"".join(v.to_bytes(8, "little") for v in self.items)
-            # pad to whole chunks; absent chunks fold in as ZERO_HASHES
-            if len(data) % 32:
-                data += b"\x00" * (32 - len(data) % 32)
-            nodes = [data[i : i + 32] for i in range(0, len(data), 32)]
-            if not nodes:
-                nodes = [ZERO_HASHES[0]]
-            level = 0
-            while level < _BLOCK_DEPTH:
-                if len(nodes) % 2:
-                    nodes.append(ZERO_HASHES[level])
-                nodes = [
-                    hashlib.sha256(nodes[i] + nodes[i + 1]).digest()
-                    for i in range(0, len(nodes), 2)
-                ]
-                level += 1
-            self.root = nodes[0]
+            self.root = _fold_values(self.items, _BLOCK_DEPTH)
         return self.root
 
 
@@ -200,23 +204,10 @@ class PersistentList:
         total_depth = (limit_chunks - 1).bit_length() if limit_chunks > 1 else 0
         if total_depth < _BLOCK_DEPTH:
             # list type smaller than one block: the depth-10 block memo
-            # frame doesn't apply — fold the chunks at the type's true
-            # depth (clamping to _BLOCK_DEPTH here would silently produce
-            # a non-SSZ root)
-            data = b"".join(v.to_bytes(8, "little") for v in self)
-            if len(data) % 32:
-                data += b"\x00" * (32 - len(data) % 32)
-            nodes = [data[i : i + 32] for i in range(0, len(data), 32)] or [
-                ZERO_HASHES[0]
-            ]
-            for level in range(total_depth):
-                if len(nodes) % 2:
-                    nodes.append(ZERO_HASHES[level])
-                nodes = [
-                    hash32_concat(nodes[i], nodes[i + 1])
-                    for i in range(0, len(nodes), 2)
-                ]
-            return nodes[0]
+            # frame doesn't apply — fold at the type's true depth
+            # (clamping to _BLOCK_DEPTH would silently produce a non-SSZ
+            # root)
+            return _fold_values(list(self), total_depth)
         roots = [blk.subtree_root() for blk in self._blocks]
         if not roots:
             roots = [ZERO_HASHES[_BLOCK_DEPTH]]

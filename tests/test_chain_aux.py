@@ -157,6 +157,24 @@ def test_fork_revert_wipes_descendants_and_blacklists():
     assert h.chain.head_state.slot == 7
 
 
+def test_compare_fields_pinpoints_divergence():
+    """compare_fields derive analog: field-wise state diffing."""
+    from lighthouse_tpu.utils.compare_fields import compare_fields
+
+    h = _harness()
+    a = h.chain.head_state
+    b = a.copy()
+    assert compare_fields(a, b) == []
+    b.slot = 99
+    b.balances[3] = 123
+    b.validators[1].slashed = True
+    diffs = {d.path: d for d in compare_fields(a, b)}
+    assert any(p.endswith(".slot") for p in diffs)
+    assert any("balances[3]" in p for p in diffs)
+    assert any("validators[1].slashed" in p for p in diffs)
+    assert len(diffs) == 3
+
+
 def test_fork_revert_refuses_finalized():
     h = _harness()
     h.extend_chain(4 * E.SLOTS_PER_EPOCH)

@@ -1,0 +1,80 @@
+"""Chain-segment import with one segment-wide signature batch
+(signature_verify_chain_segment, block_verification.rs:568)."""
+
+import pytest
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec
+
+E = MinimalEthSpec
+N_BLOCKS = 5
+
+
+def _build_segment(n_validators=8, n_blocks=N_BLOCKS):
+    src = BeaconChainHarness(minimal_spec(), E, validator_count=n_validators)
+    blocks = []
+    for slot in range(1, n_blocks + 1):
+        src.slot_clock.set_slot(slot)
+        src.add_block_at_slot(slot)
+        blocks.append(src.chain._blocks_by_root[src.chain.head_root])
+        src.attest_to_head(slot)
+    return src, blocks
+
+
+def test_segment_imports_with_single_batch(monkeypatch):
+    bls.set_backend("host")
+    try:
+        src, blocks = _build_segment()
+        dst = BeaconChainHarness(minimal_spec(), E, validator_count=8)
+        dst.slot_clock.set_slot(N_BLOCKS)
+        calls = []
+        real = bls.verify_signature_sets
+
+        def counting(sets, rng=None):
+            calls.append(len(sets))
+            return real(sets, rng)
+
+        monkeypatch.setattr(bls, "verify_signature_sets", counting)
+        res = dst.chain.process_chain_segment(blocks)
+        assert res.error is None and res.imported == N_BLOCKS
+        assert dst.chain.head_root == src.chain.head_root
+        # ONE batch covered the whole segment: a single call holding every
+        # set (proposals + randao + attestations across all blocks); the
+        # per-block imports then ran signature-free
+        assert len(calls) == 1, calls
+        assert calls[0] >= 2 * N_BLOCKS  # >= proposal+randao per block
+    finally:
+        bls.set_backend("host")
+
+
+def test_segment_with_bad_signature_rejected_atomically():
+    bls.set_backend("host")
+    src, blocks = _build_segment()
+    # corrupt the proposer signature of the middle block
+    bad = blocks[2]
+    tampered = type(bad)(
+        message=bad.message,
+        signature=b"\x01" + bytes(bad.signature)[1:],
+    )
+    blocks[2] = tampered
+    dst = BeaconChainHarness(minimal_spec(), E, validator_count=8)
+    dst.slot_clock.set_slot(N_BLOCKS)
+    res = dst.chain.process_chain_segment(blocks)
+    assert res.error is not None
+    assert res.imported == 0  # batch failed before anything imported
+    assert dst.chain.head_state.slot == 0
+
+
+def test_segment_not_a_chain_rejected():
+    bls.set_backend("fake_crypto")
+    try:
+        _src, blocks = _build_segment()
+        shuffled = [blocks[0], blocks[3], blocks[1]]
+        dst = BeaconChainHarness(minimal_spec(), E, validator_count=8)
+        dst.slot_clock.set_slot(N_BLOCKS)
+        res = dst.chain.process_chain_segment(shuffled)
+        assert res.error is not None and "chain" in str(res.error)
+    finally:
+        bls.set_backend("host")

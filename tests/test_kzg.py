@@ -33,6 +33,24 @@ def _blob(seed: int, n: int = N) -> bytes:
     )
 
 
+def test_default_setup_resolves_mainnet_ceremony():
+    """default() prefers the real ceremony output when one is reachable
+    (env var, packaged file, or the known public locations) and only then
+    falls back to the insecure dev setup. On this image the reference's
+    embedded ceremony JSON is present, so default() must be mainnet-sized
+    and commit the zero blob to the identity point."""
+    import os
+
+    ts = TrustedSetup.default()
+    if not os.environ.get("LIGHTHOUSE_TPU_TRUSTED_SETUP") and not any(
+        os.path.exists(p) for p in TrustedSetup.CEREMONY_SEARCH_PATHS
+    ):
+        pytest.skip("no ceremony file reachable; dev fallback expected")
+    assert ts.n == 4096
+    c = Kzg(ts).blob_to_kzg_commitment(bytes(4096 * 32))
+    assert c[0] == 0xC0 and set(c[1:]) == {0}  # point at infinity
+
+
 def test_fft_roundtrip():
     rng = random.Random(1)
     coeffs = [rng.randrange(FR_MODULUS) for _ in range(16)]

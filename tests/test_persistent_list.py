@@ -51,6 +51,17 @@ def test_hash_tree_root_matches_reference_merkleization():
         assert T.hash_tree_root_of(p) == T.hash_tree_root_of(vals), n
 
 
+def test_coerce_never_aliases_the_source():
+    """Building a container field from an existing PersistentList must
+    insert a CoW barrier — mutating the source afterwards cannot leak."""
+    T = List[uint64, 1 << 20]
+    src = PersistentList([1, 2, 3])
+    field_val = T.coerce(src)
+    assert field_val is not src
+    src[0] = 99
+    assert field_val[0] == 1
+
+
 def test_hash_tree_root_small_limit_types():
     """Lists whose chunk limit is below one block (e.g. attesting-indices
     shapes) must still produce the exact SSZ root — regression for the
