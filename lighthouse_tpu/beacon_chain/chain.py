@@ -94,6 +94,9 @@ class BeaconChain:
         self.store.types = self.types
         self.slot_clock = slot_clock
         self.op_pool = OperationPool(spec, E)
+        from .sync_pool import SyncCommitteeMessagePool
+
+        self.sync_message_pool = SyncCommitteeMessagePool(E)
         self.observed_attesters = ObservedCache()
         self.observed_aggregators = ObservedCache()
         self.observed_block_producers = ObservedCache()
@@ -112,6 +115,9 @@ class BeaconChain:
         self.state_advance_cache = StateAdvanceCache()
         self.invalid_block_roots: set[bytes] = set()
         self._last_finalized_epoch_seen = 0
+        # prepare_beacon_proposer registrations: validator index → fee
+        # recipient, consulted when building payload attributes
+        self.proposer_preparations: dict[int, bytes] = {}
         # gossip reader threads, the VC, and sync all mutate the chain
         # concurrently; imports serialize on a loud-failure lock
         # (timeout_rw_lock.rs — starvation raises instead of deadlocking)
@@ -726,6 +732,71 @@ class BeaconChain:
         self.event_handler.register_attestation(attestation)
         return verified
 
+    def prepare_proposers(self, preparations: dict[int, bytes]):
+        """prepare_beacon_proposer (http_api + preparation_service.rs):
+        register fee recipients for upcoming proposals."""
+        for vi, recipient in preparations.items():
+            recipient = bytes(recipient)
+            if len(recipient) != 20:
+                raise ValueError(
+                    f"fee recipient must be 20 bytes, got {len(recipient)}"
+                )
+            self.proposer_preparations[int(vi)] = recipient
+
+    def _check_operation(self, process_fn, op, kind: str):
+        """Gossip-time validation for pool-bound operations: run the spec
+        processing (signatures included) against a throwaway copy of the
+        head state — an op that can't apply there must not enter the pool,
+        or the node would pack it and propose an invalid block
+        (gossip_methods.rs verify_* before re-publish + pool insert)."""
+        trial = self.head_state.copy()
+        try:
+            process_fn(trial, op, self.spec, self.E, verify_signatures=True)
+        except BlockProcessingError as e:
+            raise BlockError(f"invalid gossip {kind}: {e}") from e
+
+    def process_voluntary_exit(self, signed_exit):
+        from ..state_processing.per_block import process_voluntary_exit
+
+        self._check_operation(process_voluntary_exit, signed_exit, "exit")
+        with self.import_lock.acquire_write():
+            self.op_pool.insert_voluntary_exit(signed_exit)
+
+    def process_proposer_slashing(self, slashing):
+        from ..state_processing.per_block import process_proposer_slashing
+
+        self._check_operation(
+            process_proposer_slashing, slashing, "proposer slashing"
+        )
+        with self.import_lock.acquire_write():
+            self.op_pool.insert_proposer_slashing(slashing)
+
+    def process_attester_slashing(self, slashing):
+        from ..state_processing.per_block import process_attester_slashing
+
+        self._check_operation(
+            process_attester_slashing, slashing, "attester slashing"
+        )
+        with self.import_lock.acquire_write():
+            self.op_pool.insert_attester_slashing(slashing)
+
+    def process_sync_committee_message(self, message):
+        """Verify a gossip SyncCommitteeMessage against the current sync
+        committee and pool it for the next block's SyncAggregate."""
+        from .sync_pool import verify_sync_committee_message
+
+        positions = verify_sync_committee_message(self, message)
+        with self.import_lock.acquire_write():
+            for pos in positions:
+                self.sync_message_pool.insert(
+                    int(message.slot),
+                    bytes(message.beacon_block_root),
+                    pos,
+                    bytes(message.signature),
+                )
+            self.sync_message_pool.prune(self.slot_clock.now())
+        return positions
+
     def process_blob_sidecars(self, block_root: bytes, sidecars: list):
         """KZG-verify and stage blob sidecars for a block (gossip/RPC blobs
         path → data_availability_checker.put_blobs)."""
@@ -806,9 +877,13 @@ class BeaconChain:
         if fork >= ForkName.ALTAIR:
             if sync_aggregate_fn is not None:
                 body_kwargs["sync_aggregate"] = sync_aggregate_fn(state)
-            else:
-                body_kwargs["sync_aggregate"] = empty_sync_aggregate(
-                    self.types, self.E
+            elif self.sync_message_pool is not None:
+                # messages signed at slot-1 over the parent root pack into
+                # this block (altair/validator.md inclusion rule)
+                body_kwargs["sync_aggregate"] = (
+                    self.sync_message_pool.aggregate_for(
+                        self.types, self.E, slot - 1, parent_root
+                    )
                 )
         if fork >= ForkName.BELLATRIX:
             payload = self._produce_payload(state, fork, tf)
@@ -877,6 +952,9 @@ class BeaconChain:
                 state, get_current_epoch(state, self.E), self.E
             ),
             withdrawals=withdrawals,
+            suggested_fee_recipient=self.proposer_preparations.get(
+                get_beacon_proposer_index(state, self.E), b"\x00" * 20
+            ),
         )
         # Post-merge (and Capella+, whose spec asserts the parent link
         # unconditionally): build exactly on the state's execution header.

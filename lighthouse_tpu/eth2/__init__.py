@@ -107,6 +107,22 @@ class BeaconNodeHttpClient:
             "/eth/v1/beacon/pool/attestations", data, "application/octet-stream"
         )
 
+    def publish_sync_committee_messages_ssz(self, data: bytes) -> int:
+        return self._post(
+            "/eth/v1/beacon/pool/sync_committees",
+            data,
+            "application/octet-stream",
+        )
+
+    def prepare_beacon_proposer(self, preparations: list[dict]) -> int:
+        import json as _json
+
+        return self._post(
+            "/eth/v1/validator/prepare_beacon_proposer",
+            _json.dumps(preparations).encode(),
+            "application/json",
+        )
+
 
 class HttpBeaconNode:
     """validator_client BeaconNodeInterface over HTTP — the VC's real
@@ -143,3 +159,23 @@ class HttpBeaconNode:
     def produce_block(self, slot: int, randao_reveal: bytes):
         data = self.client.produce_block_ssz(slot, randao_reveal)
         return self.types.decode_by_fork("BeaconBlock", data)
+
+    def publish_sync_committee_messages(self, messages):
+        from ..ssz.core import List as SszList
+
+        t = self.types
+        data = SszList[t.SyncCommitteeMessage, 1024].serialize_value(
+            list(messages)
+        )
+        return self.client.publish_sync_committee_messages_ssz(data)
+
+    def prepare_proposers(self, preparations: dict[int, bytes]):
+        return self.client.prepare_beacon_proposer(
+            [
+                {
+                    "validator_index": str(vi),
+                    "fee_recipient": "0x" + bytes(fr).hex(),
+                }
+                for vi, fr in preparations.items()
+            ]
+        )

@@ -247,6 +247,40 @@ class BeaconApi:
             )
         return 200
 
+    def publish_sync_messages_ssz(self, data: bytes) -> int:
+        """POST /eth/v1/beacon/pool/sync_committees (SSZ list)."""
+        t = self.chain.types
+        from ..ssz.core import List as SszList
+
+        msgs = SszList[t.SyncCommitteeMessage, 1024].deserialize(data)
+        errors = []
+        for msg in msgs:
+            try:
+                self.chain.process_sync_committee_message(msg)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+        if errors and len(errors) == len(msgs):
+            raise ApiError(400, f"all sync messages rejected: {errors[0]}")
+        return 200
+
+    def prepare_beacon_proposer(self, preparations: list[dict]) -> int:
+        """POST /eth/v1/validator/prepare_beacon_proposer (JSON)."""
+        try:
+            prep = {}
+            for p in preparations:
+                recipient = bytes.fromhex(
+                    p["fee_recipient"].removeprefix("0x")
+                )
+                if len(recipient) != 20:
+                    raise ValueError(
+                        f"fee_recipient must be 20 bytes, got {len(recipient)}"
+                    )
+                prep[int(p["validator_index"])] = recipient
+        except (KeyError, ValueError, TypeError, AttributeError) as e:
+            raise ApiError(400, f"malformed preparation: {e}") from e
+        self.chain.prepare_proposers(prep)
+        return 200
+
     def publish_block_ssz(self, data: bytes) -> int:
         # Resolve the fork first (exact-roundtrip decode), THEN import
         # exactly once so a genuine rejection surfaces as itself and never
@@ -455,6 +489,14 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ApiError(415, "JSON block publishing not supported; use SSZ")
             if path == "/eth/v1/beacon/pool/attestations":
                 code = self.api.publish_attestations_ssz(body)
+                self._send_json({"code": code, "message": "ok"}, code)
+                return
+            if path == "/eth/v1/beacon/pool/sync_committees":
+                code = self.api.publish_sync_messages_ssz(body)
+                self._send_json({"code": code, "message": "ok"}, code)
+                return
+            if path == "/eth/v1/validator/prepare_beacon_proposer":
+                code = self.api.prepare_beacon_proposer(json.loads(body))
                 self._send_json({"code": code, "message": "ok"}, code)
                 return
             raise ApiError(404, f"unknown route {path}")

@@ -378,8 +378,30 @@ class NetworkService:
         digest = self.fork_digest()
         self.topic_block = M.gossip_topic(digest, M.TOPIC_BEACON_BLOCK)
         self.topic_att = M.gossip_topic(digest, M.TOPIC_BEACON_ATTESTATION)
+        self.topic_aggregate = M.gossip_topic(digest, M.TOPIC_AGGREGATE)
+        self.topic_exit = M.gossip_topic(digest, M.TOPIC_VOLUNTARY_EXIT)
+        self.topic_proposer_slashing = M.gossip_topic(
+            digest, M.TOPIC_PROPOSER_SLASHING
+        )
+        self.topic_attester_slashing = M.gossip_topic(
+            digest, M.TOPIC_ATTESTER_SLASHING
+        )
+        self.topic_sync_committee = M.gossip_topic(
+            digest, M.TOPIC_SYNC_COMMITTEE
+        )
         self.gossip.subscribe(self.topic_block, self._on_gossip_block)
         self.gossip.subscribe(self.topic_att, self._on_gossip_attestation)
+        self.gossip.subscribe(self.topic_aggregate, self._on_gossip_aggregate)
+        self.gossip.subscribe(self.topic_exit, self._on_gossip_exit)
+        self.gossip.subscribe(
+            self.topic_proposer_slashing, self._on_gossip_proposer_slashing
+        )
+        self.gossip.subscribe(
+            self.topic_attester_slashing, self._on_gossip_attester_slashing
+        )
+        self.gossip.subscribe(
+            self.topic_sync_committee, self._on_gossip_sync_committee
+        )
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -562,6 +584,34 @@ class NetworkService:
         if results and isinstance(results[0], Exception):
             raise results[0]
 
+    def _on_gossip_aggregate(self, data: bytes):
+        t = self.chain.types
+        agg = t.SignedAggregateAndProof.deserialize(data)
+        self.chain.process_aggregate(agg)
+
+    def _on_gossip_exit(self, data: bytes):
+        """Exits/slashings are spec-verified (signatures included) against
+        the head state before pooling — an unverifiable op would otherwise
+        be packed into our own proposal (gossip_methods.rs)."""
+        t = self.chain.types
+        exit_ = t.SignedVoluntaryExit.deserialize(data)
+        self.chain.process_voluntary_exit(exit_)
+
+    def _on_gossip_proposer_slashing(self, data: bytes):
+        t = self.chain.types
+        slashing = t.ProposerSlashing.deserialize(data)
+        self.chain.process_proposer_slashing(slashing)
+
+    def _on_gossip_attester_slashing(self, data: bytes):
+        t = self.chain.types
+        slashing = t.AttesterSlashing.deserialize(data)
+        self.chain.process_attester_slashing(slashing)
+
+    def _on_gossip_sync_committee(self, data: bytes):
+        t = self.chain.types
+        msg = t.SyncCommitteeMessage.deserialize(data)
+        self.chain.process_sync_committee_message(msg)
+
     # -- publishing -------------------------------------------------------------
 
     def publish_block(self, signed_block):
@@ -572,6 +622,21 @@ class NetworkService:
         self.gossip.publish(
             self.topic_att, t.Attestation.serialize_value(attestation)
         )
+
+    def publish_aggregate(self, signed_aggregate):
+        self.gossip.publish(self.topic_aggregate, signed_aggregate.serialize())
+
+    def publish_voluntary_exit(self, signed_exit):
+        self.gossip.publish(self.topic_exit, signed_exit.serialize())
+
+    def publish_proposer_slashing(self, slashing):
+        self.gossip.publish(self.topic_proposer_slashing, slashing.serialize())
+
+    def publish_attester_slashing(self, slashing):
+        self.gossip.publish(self.topic_attester_slashing, slashing.serialize())
+
+    def publish_sync_committee_message(self, message):
+        self.gossip.publish(self.topic_sync_committee, message.serialize())
 
     # -- RPC server data providers ----------------------------------------------
 

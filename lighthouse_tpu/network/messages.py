@@ -78,3 +78,7 @@ PROTO_GOSSIP = "/lighthouse_tpu/gossip/1"  # persistent pub/sub stream
 TOPIC_BEACON_BLOCK = "beacon_block"
 TOPIC_BEACON_ATTESTATION = "beacon_attestation_0"
 TOPIC_AGGREGATE = "beacon_aggregate_and_proof"
+TOPIC_VOLUNTARY_EXIT = "voluntary_exit"
+TOPIC_PROPOSER_SLASHING = "proposer_slashing"
+TOPIC_ATTESTER_SLASHING = "attester_slashing"
+TOPIC_SYNC_COMMITTEE = "sync_committee_0"

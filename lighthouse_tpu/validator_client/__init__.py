@@ -98,6 +98,18 @@ class ValidatorStore:
         )
         return self._signers[bytes(pubkey)].sign(root)
 
+    def sign_sync_committee_message(
+        self, pubkey: bytes, slot: int, block_root: bytes, state, spec, E
+    ):
+        """altair/validator.md: sign the head root under
+        DOMAIN_SYNC_COMMITTEE of the slot's epoch (no slashing conditions
+        apply to sync messages — no slashing-db entry)."""
+        domain = get_domain(
+            state, Domain.SYNC_COMMITTEE, compute_epoch_at_slot(slot, E), spec, E
+        )
+        root = compute_signing_root(bytes(block_root), domain)
+        return self._signers[bytes(pubkey)].sign(root)
+
 
 class BeaconNodeInterface:
     """What the services need from a BN (common/eth2 client surface)."""
@@ -112,6 +124,12 @@ class BeaconNodeInterface:
         raise NotImplementedError
 
     def produce_block(self, slot: int, randao_reveal: bytes):
+        raise NotImplementedError
+
+    def publish_sync_committee_messages(self, messages):
+        raise NotImplementedError
+
+    def prepare_proposers(self, preparations: dict[int, bytes]):
         raise NotImplementedError
 
 
@@ -136,6 +154,13 @@ class LocalBeaconNode(BeaconNodeInterface):
     def produce_block(self, slot: int, randao_reveal: bytes):
         block, _post = self.chain.produce_block_on_state(slot, randao_reveal)
         return block
+
+    def publish_sync_committee_messages(self, messages):
+        for msg in messages:
+            self.chain.process_sync_committee_message(msg)
+
+    def prepare_proposers(self, preparations: dict[int, bytes]):
+        self.chain.prepare_proposers(preparations)
 
 
 class DutiesService:
@@ -310,6 +335,103 @@ class BlockService:
         return root
 
 
+class SyncCommitteeService:
+    """Signs and publishes sync-committee messages for managed keys in
+    the current sync committee (sync_committee_service.rs)."""
+
+    def __init__(self, store: ValidatorStore, node, spec, E):
+        self.store = store
+        self.node = node
+        self.spec = spec
+        self.E = E
+        # sync-committee membership changes once per period and the
+        # registry scan costs a full state fetch over HTTP — cache both
+        # per epoch (duties_service epoch-cache rationale)
+        self._cache_epoch: int | None = None
+        self._members: list[tuple[int, bytes]] = []
+        self._domain_state = None
+
+    def _refresh(self, epoch: int):
+        if epoch == self._cache_epoch:
+            return
+        state = self.node.head_state()
+        self._cache_epoch = epoch
+        self._domain_state = state
+        self._members = []
+        committee = getattr(state, "current_sync_committee", None)
+        if committee is None:
+            return  # phase0: no sync committees yet
+        managed = set(self.store.pubkeys())
+        by_pubkey = {}
+        for i, v in enumerate(state.validators):
+            pk = bytes(v.pubkey)
+            if pk in managed:
+                by_pubkey[pk] = i
+        seen = set()
+        for pk in committee.pubkeys:
+            pk = bytes(pk)
+            vi = by_pubkey.get(pk)
+            if vi is None or vi in seen:
+                continue  # one message per validator even with N positions
+            seen.add(vi)
+            self._members.append((vi, pk))
+
+    def sign_messages(self, slot: int, head_root: bytes) -> list:
+        from ..types.containers import build_types
+
+        t = build_types(self.E)
+        self._refresh(compute_epoch_at_slot(slot, self.E))
+        out = []
+        for vi, pk in self._members:
+            sig = self.store.sign_sync_committee_message(
+                pk, slot, head_root, self._domain_state, self.spec, self.E
+            )
+            out.append(
+                t.SyncCommitteeMessage(
+                    slot=slot,
+                    beacon_block_root=head_root,
+                    validator_index=vi,
+                    signature=sig,
+                )
+            )
+        if out:
+            self.node.publish_sync_committee_messages(out)
+            inc_counter(
+                "vc_sync_committee_messages_published_total", amount=len(out)
+            )
+        return out
+
+
+class PreparationService:
+    """Registers fee recipients for managed validators ahead of their
+    proposals (preparation_service.rs; prepare_beacon_proposer API)."""
+
+    def __init__(self, store: ValidatorStore, node, fee_recipient: bytes = b"\x00" * 20):
+        self.store = store
+        self.node = node
+        self.default_fee_recipient = bytes(fee_recipient)
+        self.per_validator: dict[bytes, bytes] = {}
+        self._registered_epoch = -1
+
+    def set_fee_recipient(self, pubkey: bytes, recipient: bytes):
+        self.per_validator[bytes(pubkey)] = bytes(recipient)
+
+    def prepare(self, epoch: int):
+        """Once per epoch: push {validator_index: fee_recipient}."""
+        if epoch == self._registered_epoch:
+            return
+        state = self.node.head_state()
+        managed = set(self.store.pubkeys())
+        prep = {}
+        for i, v in enumerate(state.validators):
+            pk = bytes(v.pubkey)
+            if pk in managed:
+                prep[i] = self.per_validator.get(pk, self.default_fee_recipient)
+        if prep:
+            self.node.prepare_proposers(prep)
+            self._registered_epoch = epoch
+
+
 class DoppelgangerService:
     """Liveness gate: refuse signing for N epochs while watching for our
     keys attesting elsewhere (doppelganger_service.rs, simplified to the
@@ -334,7 +456,16 @@ class ValidatorClient:
     """ProductionValidatorClient analog: wires the services and drives them
     per slot (lib.rs:91-98)."""
 
-    def __init__(self, chain, keypairs, spec, E, slashing_db=None, node=None):
+    def __init__(
+        self,
+        chain,
+        keypairs,
+        spec,
+        E,
+        slashing_db=None,
+        node=None,
+        fee_recipient: bytes = b"\x00" * 20,
+    ):
         self.chain = chain  # None when running over a remote node interface
         self.spec = spec
         self.E = E
@@ -349,14 +480,24 @@ class ValidatorClient:
         self.block_service = BlockService(
             self.duties_service, self.store, self.node, spec, E
         )
+        self.sync_committee_service = SyncCommitteeService(
+            self.store, self.node, spec, E
+        )
+        self.preparation_service = PreparationService(
+            self.store, self.node, fee_recipient
+        )
         self.doppelganger = DoppelgangerService(chain, self.store)
 
     def on_slot(self, slot: int):
-        """One slot of VC work: propose (if due), then attest."""
+        """One slot of VC work in duty order: prepare (epoch-cadence),
+        propose (if due), attest, then sync-committee messages over the
+        resulting head (lib.rs:91-98 service set)."""
         epoch = compute_epoch_at_slot(slot, self.E)
         if not self.doppelganger.signing_enabled(epoch):
             return None
+        self.preparation_service.prepare(epoch)
         root = self.block_service.propose_if_due(slot)
         head = self.node.head_root()
         self.attestation_service.attest(slot, head)
+        self.sync_committee_service.sign_messages(slot, head)
         return root

@@ -134,3 +134,9 @@ class BeaconNodeFallback:
 
     def produce_block(self, slot: int, randao_reveal: bytes):
         return self.first_success("produce_block", slot, randao_reveal)
+
+    def publish_sync_committee_messages(self, messages):
+        return self.first_success("publish_sync_committee_messages", messages)
+
+    def prepare_proposers(self, preparations):
+        return self.first_success("prepare_proposers", preparations)

@@ -122,6 +122,70 @@ def test_invalid_gossip_downscores_and_bans(two_nodes):
         na.connect("127.0.0.1", target.port)
 
 
+def test_gossip_operation_topics_feed_pools(two_nodes):
+    """Exits, slashings, and sync-committee messages gossip across nodes
+    into the op/sync pools (gossip_methods.rs operation handlers)."""
+    a, na, b, nb = two_nodes
+    b.slot_clock.set_slot(a.chain.head_state.slot)
+    nb.connect("127.0.0.1", na.port)
+    time.sleep(0.2)
+    t = b.chain.types
+
+    # this exit is spec-invalid at epoch 1 (validator hasn't been active
+    # for SHARD_COMMITTEE_PERIOD) — gossip verification must refuse to
+    # pool it even though fake_crypto would accept the signature
+    exit_ = t.SignedVoluntaryExit(
+        message=t.VoluntaryExit(epoch=0, validator_index=3),
+        signature=b"\x0b" * 96,
+    )
+    nb.publish_voluntary_exit(exit_)
+
+    header = t.BeaconBlockHeader(
+        slot=1, proposer_index=2, parent_root=b"\x01" * 32,
+        state_root=b"\x02" * 32, body_root=b"\x03" * 32,
+    )
+    header2 = t.BeaconBlockHeader(
+        slot=1, proposer_index=2, parent_root=b"\x04" * 32,
+        state_root=b"\x02" * 32, body_root=b"\x03" * 32,
+    )
+    slashing = t.ProposerSlashing(
+        signed_header_1=t.SignedBeaconBlockHeader(
+            message=header, signature=b"\x0c" * 96
+        ),
+        signed_header_2=t.SignedBeaconBlockHeader(
+            message=header2, signature=b"\x0d" * 96
+        ),
+    )
+    nb.publish_proposer_slashing(slashing)
+
+    state = a.chain.head_state
+    member_pk = bytes(state.current_sync_committee.pubkeys[0])
+    vi = next(
+        i for i, v in enumerate(state.validators)
+        if bytes(v.pubkey) == member_pk
+    )
+    msg = t.SyncCommitteeMessage(
+        slot=int(state.slot),
+        beacon_block_root=a.chain.head_root,
+        validator_index=vi,
+        signature=b"\x0e" * 96,  # fake_crypto accepts
+    )
+    nb.publish_sync_committee_message(msg)
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if (
+            a.chain.op_pool._proposer_slashings
+            and a.chain.sync_message_pool._msgs
+        ):
+            break
+        time.sleep(0.05)
+    assert a.chain.op_pool._proposer_slashings
+    assert a.chain.sync_message_pool._msgs
+    # the invalid exit was verified at gossip time and never pooled
+    assert not a.chain.op_pool._voluntary_exits
+
+
 def test_fork_digest_mismatch_rejected():
     a = _harness()
     spec2 = replace(minimal_spec(), altair_fork_epoch=0, altair_fork_version=b"\x09\x00\x00\x09")
