@@ -780,6 +780,11 @@ class BeaconChain:
         with self.import_lock.acquire_write():
             self.op_pool.insert_attester_slashing(slashing)
 
+    def get_aggregated_attestation(self, data):
+        """Pool aggregate for an AttestationData (the
+        /eth/v1/validator/aggregate_attestation surface)."""
+        return self.op_pool.get_aggregate(data.hash_tree_root())
+
     def process_sync_committee_message(self, message):
         """Verify a gossip SyncCommitteeMessage against the current sync
         committee and pool it for the next block's SyncAggregate."""

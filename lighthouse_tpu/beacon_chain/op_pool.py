@@ -64,12 +64,41 @@ class OperationPool:
         if len(bucket) < self.MAX_AGGREGATES_PER_DATA:
             bucket[key] = attestation
 
+    def get_aggregate(self, data_root: bytes):
+        """Best (highest-participation) running aggregate for an
+        AttestationData root — the get_aggregate_attestation API surface
+        aggregators read (naive aggregation pool `get`)."""
+        bucket = self._attestations.get(bytes(data_root))
+        if not bucket:
+            return None
+        return max(bucket.values(), key=lambda a: sum(a.aggregation_bits))
+
     def insert_proposer_slashing(self, slashing):
         self._proposer_slashings[
             slashing.signed_header_1.message.proposer_index
         ] = slashing
 
+    #: bound on distinct pooled attester slashings (gossip flood guard)
+    MAX_ATTESTER_SLASHINGS_POOLED = 128
+
+    @staticmethod
+    def _slashable_indices(asl) -> set:
+        return set(asl.attestation_1.attesting_indices) & set(
+            asl.attestation_2.attesting_indices
+        )
+
     def insert_attester_slashing(self, slashing):
+        """Pool only slashings that cover at least one validator no pooled
+        slashing already covers — overlapping entries would pack together
+        and fail the block's slashed_any check."""
+        new = self._slashable_indices(slashing)
+        covered: set = set()
+        for asl in self._attester_slashings:
+            covered |= self._slashable_indices(asl)
+        if not (new - covered):
+            return
+        if len(self._attester_slashings) >= self.MAX_ATTESTER_SLASHINGS_POOLED:
+            return
         self._attester_slashings.append(slashing)
 
     def insert_voluntary_exit(self, exit_):
@@ -147,18 +176,24 @@ class OperationPool:
             if idx < n_vals and is_slashable_validator(state.validators[idx], epoch)
         ][: E.MAX_PROPOSER_SLASHINGS]
 
-        def slashing_applicable(asl):
-            common = set(asl.attestation_1.attesting_indices) & set(
-                asl.attestation_2.attesting_indices
-            )
-            return any(
-                i < n_vals and is_slashable_validator(state.validators[i], epoch)
-                for i in common
-            )
-
-        attester_slashings = [
-            asl for asl in self._attester_slashings if slashing_applicable(asl)
-        ][: E.MAX_ATTESTER_SLASHINGS]
+        # greedy pick tracking which validators this block will already
+        # slash — two overlapping slashings in one block fail the spec's
+        # slashed_any requirement on the second
+        attester_slashings = []
+        to_be_slashed: set = set()
+        for asl in self._attester_slashings:
+            if len(attester_slashings) >= E.MAX_ATTESTER_SLASHINGS:
+                break
+            fresh = {
+                i
+                for i in self._slashable_indices(asl)
+                if i < n_vals
+                and is_slashable_validator(state.validators[i], epoch)
+                and i not in to_be_slashed
+            }
+            if fresh:
+                attester_slashings.append(asl)
+                to_be_slashed |= fresh
 
         exits = [
             ex

@@ -67,6 +67,14 @@ def verify_sync_committee_message(chain, message) -> list[int]:
     verify over (block_root, DOMAIN_SYNC_COMMITTEE @ epoch(slot)).
     Returns the validator's committee positions (a validator can occupy
     several)."""
+    now = chain.slot_clock.now()
+    if not (now - 1 <= int(message.slot) <= now + 1):
+        # gossip condition: message.slot must be the current slot (±1 for
+        # clock disparity) — future-slot messages would otherwise pool
+        # unboundedly (prune only drops past slots)
+        raise SyncMessageError(
+            f"sync message slot {message.slot} outside tolerance of {now}"
+        )
     state = chain.head_state
     committee = getattr(state, "current_sync_committee", None)
     if committee is None:

@@ -267,6 +267,30 @@ def cmd_interop_keys(args):
 # ---------------------------------------------------------------------------
 
 
+def cmd_boot_node(args):
+    """Standalone discovery bootstrap server (the boot_node crate,
+    boot_node/src/lib.rs:1): runs the discv5-analog UDP discovery stack
+    with no chain attached; beacon nodes seed their --bootnodes with its
+    printed record."""
+    import json
+    import time
+
+    from .network.discovery import BootNode
+
+    boot = BootNode(host=args.listen_address).start()
+    print(json.dumps(boot.enr().to_dict()))
+    deadline = time.time() + args.run_for if args.run_for else None
+    try:
+        while deadline is None or time.time() < deadline:
+            time.sleep(1.0)
+            boot.discovery.maintain()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        boot.stop()
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="lighthouse-tpu", description=__doc__.splitlines()[0]
@@ -287,6 +311,11 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--fake-crypto", action="store_true")
     bn.add_argument("--run-for", type=float, default=None, help="seconds then exit")
     bn.set_defaults(fn=cmd_beacon_node)
+
+    boot = sub.add_parser("boot-node", help="standalone discovery bootstrap")
+    boot.add_argument("--listen-address", default="127.0.0.1")
+    boot.add_argument("--run-for", type=float, default=None)
+    boot.set_defaults(fn=cmd_boot_node)
 
     pretty = sub.add_parser("pretty-ssz", help="decode an SSZ file")
     pretty.add_argument("type", choices=["state", "block"])

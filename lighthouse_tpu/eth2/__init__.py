@@ -169,6 +169,33 @@ class HttpBeaconNode:
         )
         return self.client.publish_sync_committee_messages_ssz(data)
 
+    def get_aggregate(self, data):
+        try:
+            raw = self.client._get(
+                "/eth/v1/validator/aggregate_attestation"
+                f"?slot={int(data.slot)}"
+                f"&attestation_data_root=0x{data.hash_tree_root().hex()}",
+                ssz=True,
+            )
+        except ApiClientError as e:
+            if e.code == 404:
+                return None
+            raise
+        return self.types.Attestation.deserialize(raw)
+
+    def publish_aggregates(self, signed_aggregates):
+        from ..ssz.core import List as SszList
+
+        t = self.types
+        data = SszList[t.SignedAggregateAndProof, 1024].serialize_value(
+            list(signed_aggregates)
+        )
+        return self.client._post(
+            "/eth/v1/validator/aggregate_and_proofs",
+            data,
+            "application/octet-stream",
+        )
+
     def prepare_proposers(self, preparations: dict[int, bytes]):
         return self.client.prepare_beacon_proposer(
             [

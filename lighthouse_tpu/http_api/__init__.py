@@ -247,6 +247,30 @@ class BeaconApi:
             )
         return 200
 
+    def get_aggregate_ssz(self, slot: int, data_root: bytes) -> bytes:
+        """GET /eth/v1/validator/aggregate_attestation (SSZ body)."""
+        agg = self.chain.op_pool.get_aggregate(data_root)
+        if agg is None or int(agg.data.slot) != int(slot):
+            raise ApiError(404, "no aggregate for that data root")
+        t = self.chain.types
+        return t.Attestation.serialize_value(agg)
+
+    def publish_aggregates_ssz(self, data: bytes) -> int:
+        """POST /eth/v1/validator/aggregate_and_proofs (SSZ list)."""
+        t = self.chain.types
+        from ..ssz.core import List as SszList
+
+        aggs = SszList[t.SignedAggregateAndProof, 1024].deserialize(data)
+        errors = []
+        for agg in aggs:
+            try:
+                self.chain.process_aggregate(agg)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+        if errors and len(errors) == len(aggs):
+            raise ApiError(400, f"all aggregates rejected: {errors[0]}")
+        return 200
+
     def publish_sync_messages_ssz(self, data: bytes) -> int:
         """POST /eth/v1/beacon/pool/sync_committees (SSZ list)."""
         t = self.chain.types
@@ -404,6 +428,17 @@ class _Handler(BaseHTTPRequestHandler):
             if m:
                 self._send_bytes(self.api.debug_state_ssz(m.group("state_id")))
                 return
+            if path == "/eth/v1/validator/aggregate_attestation":
+                q = parse_qs(parsed.query)
+                self._send_bytes(
+                    self.api.get_aggregate_ssz(
+                        int(q["slot"][0]),
+                        bytes.fromhex(
+                            q["attestation_data_root"][0].removeprefix("0x")
+                        ),
+                    )
+                )
+                return
             m = re.match(r"^/eth/v3/validator/blocks/(?P<slot>\d+)$", path)
             if m:
                 q = parse_qs(parsed.query)
@@ -489,6 +524,10 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ApiError(415, "JSON block publishing not supported; use SSZ")
             if path == "/eth/v1/beacon/pool/attestations":
                 code = self.api.publish_attestations_ssz(body)
+                self._send_json({"code": code, "message": "ok"}, code)
+                return
+            if path == "/eth/v1/validator/aggregate_and_proofs":
+                code = self.api.publish_aggregates_ssz(body)
                 self._send_json({"code": code, "message": "ok"}, code)
                 return
             if path == "/eth/v1/beacon/pool/sync_committees":

@@ -126,19 +126,25 @@ def deposit_signature_message(deposit_data, spec: ChainSpec, E) -> bytes:
     return compute_signing_root(msg.hash_tree_root(), spec.get_deposit_domain())
 
 
-def selection_proof_signature_set(
-    state, validator_index: int, slot: int, selection_proof, spec: ChainSpec, E
-) -> bls.SignatureSet:
+def selection_proof_signing_root(state, slot: int, spec: ChainSpec, E) -> bytes:
+    """The ONE definition of the selection-proof message (validator.md
+    get_slot_signature): shared by the VC's signer and the verifier so the
+    recipe can never diverge."""
     domain = get_domain(
         state, Domain.SELECTION_PROOF, compute_epoch_at_slot(slot, E), spec, E
     )
-    message = compute_signing_root(
-        slot.to_bytes(8, "little").ljust(32, b"\x00"), domain
+    return compute_signing_root(
+        int(slot).to_bytes(8, "little").ljust(32, b"\x00"), domain
     )
+
+
+def selection_proof_signature_set(
+    state, validator_index: int, slot: int, selection_proof, spec: ChainSpec, E
+) -> bls.SignatureSet:
     return bls.SignatureSet.single(
         bls.Signature(selection_proof),
         validator_pubkey(state, validator_index),
-        message,
+        selection_proof_signing_root(state, slot, spec, E),
     )
 
 

@@ -98,6 +98,28 @@ class ValidatorStore:
         )
         return self._signers[bytes(pubkey)].sign(root)
 
+    def sign_selection_proof(self, pubkey: bytes, slot: int, state, spec, E):
+        """DOMAIN_SELECTION_PROOF over the slot — the signing root comes
+        from the verifier's own recipe (signature_sets) so they can't
+        diverge."""
+        from ..state_processing.signature_sets import (
+            selection_proof_signing_root,
+        )
+
+        root = selection_proof_signing_root(state, slot, spec, E)
+        return self._signers[bytes(pubkey)].sign(root)
+
+    def sign_aggregate_and_proof(self, pubkey: bytes, agg_and_proof, state, spec, E):
+        domain = get_domain(
+            state,
+            Domain.AGGREGATE_AND_PROOF,
+            compute_epoch_at_slot(agg_and_proof.aggregate.data.slot, E),
+            spec,
+            E,
+        )
+        root = compute_signing_root(agg_and_proof.hash_tree_root(), domain)
+        return self._signers[bytes(pubkey)].sign(root)
+
     def sign_sync_committee_message(
         self, pubkey: bytes, slot: int, block_root: bytes, state, spec, E
     ):
@@ -132,6 +154,12 @@ class BeaconNodeInterface:
     def prepare_proposers(self, preparations: dict[int, bytes]):
         raise NotImplementedError
 
+    def get_aggregate(self, data):
+        raise NotImplementedError
+
+    def publish_aggregates(self, signed_aggregates):
+        raise NotImplementedError
+
 
 class LocalBeaconNode(BeaconNodeInterface):
     """In-process BN (the HTTP client's stand-in for tests/sim)."""
@@ -161,6 +189,20 @@ class LocalBeaconNode(BeaconNodeInterface):
 
     def prepare_proposers(self, preparations: dict[int, bytes]):
         self.chain.prepare_proposers(preparations)
+
+    def get_aggregate(self, data):
+        return self.chain.get_aggregated_attestation(data)
+
+    def publish_aggregates(self, signed_aggregates):
+        """Per-item: one rejected aggregate (e.g. the aggregator-seen
+        dedup) must not drop the valid ones behind it."""
+        out = []
+        for agg in signed_aggregates:
+            try:
+                out.append(self.chain.process_aggregate(agg))
+            except Exception as e:  # noqa: BLE001
+                out.append(e)
+        return out
 
 
 class DutiesService:
@@ -245,6 +287,8 @@ class AttestationService:
         self.node = node
         self.spec = spec
         self.E = E
+        self._last_attested: tuple = (None, None)
+        self._last_attestations: list = []
 
     def attest(self, slot: int, head_root: bytes) -> list:
         from ..state_processing import per_slot_processing
@@ -294,7 +338,66 @@ class AttestationService:
         if out:
             self.node.publish_attestations(out)
             inc_counter("vc_attestations_published_total", amount=len(out))
+        self._last_attested = (slot, state)
+        self._last_attestations = out
         return out
+
+    def aggregate_if_selected(self, slot: int) -> list:
+        """Second phase of the attestation duty (validator.md 2/3-slot
+        mark): each managed attester computes its selection proof; those
+        selected as aggregators fetch the pool's best aggregate for their
+        committee and publish a SignedAggregateAndProof
+        (attestation_service.rs aggregate production)."""
+        from ..beacon_chain.attestation_verification import is_aggregator
+        from ..types.containers import build_types
+
+        last_slot, state = getattr(self, "_last_attested", (None, None))
+        if last_slot != slot or state is None:
+            return []
+        t = build_types(self.E)
+        published = []
+        for duty in self.duties.attester_duties(
+            compute_epoch_at_slot(slot, self.E)
+        ):
+            if duty.slot != slot:
+                continue
+            pk = bytes(state.validators[duty.validator_index].pubkey)
+            proof = self.store.sign_selection_proof(
+                pk, slot, state, self.spec, self.E
+            )
+            if not is_aggregator(duty.committee_size, proof, self.E):
+                continue
+            # the data our attest() phase produced for this duty
+            agg = None
+            for att in getattr(self, "_last_attestations", []):
+                if (
+                    att.data.slot == slot
+                    and att.data.index == duty.committee_index
+                ):
+                    agg = self.node.get_aggregate(att.data)
+                    break
+            if agg is None:
+                continue
+            aap = t.AggregateAndProof(
+                aggregator_index=duty.validator_index,
+                aggregate=agg,
+                selection_proof=proof,
+            )
+            sig = self.store.sign_aggregate_and_proof(
+                pk, aap, state, self.spec, self.E
+            )
+            published.append(
+                t.SignedAggregateAndProof(message=aap, signature=sig)
+            )
+        if published:
+            results = self.node.publish_aggregates(published)
+            accepted = sum(
+                1
+                for r in (results or [])
+                if not isinstance(r, Exception)
+            )
+            inc_counter("vc_aggregates_published_total", amount=accepted)
+        return published
 
 
 class BlockService:
@@ -429,7 +532,9 @@ class PreparationService:
                 prep[i] = self.per_validator.get(pk, self.default_fee_recipient)
         if prep:
             self.node.prepare_proposers(prep)
-            self._registered_epoch = epoch
+        # epoch recorded even when empty: the registry scan costs a full
+        # state fetch and must stay once-per-epoch
+        self._registered_epoch = epoch
 
 
 class DoppelgangerService:
@@ -499,5 +604,6 @@ class ValidatorClient:
         root = self.block_service.propose_if_due(slot)
         head = self.node.head_root()
         self.attestation_service.attest(slot, head)
+        self.attestation_service.aggregate_if_selected(slot)
         self.sync_committee_service.sign_messages(slot, head)
         return root

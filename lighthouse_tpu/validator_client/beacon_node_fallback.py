@@ -140,3 +140,9 @@ class BeaconNodeFallback:
 
     def prepare_proposers(self, preparations):
         return self.first_success("prepare_proposers", preparations)
+
+    def get_aggregate(self, data):
+        return self.first_success("get_aggregate", data)
+
+    def publish_aggregates(self, signed_aggregates):
+        return self.first_success("publish_aggregates", signed_aggregates)

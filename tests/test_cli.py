@@ -75,3 +75,25 @@ def test_interop_keys_cmd(capsys):
     assert main(["interop-keys", "2"]) == 0
     out = capsys.readouterr().out
     assert "a99a76ed7796f7be22d5b7e8" in out  # well-known interop pk 0
+
+
+def test_boot_node_cmd_serves_discovery(capsys):
+    """boot-node subcommand (boot_node crate analog): prints its record
+    and answers discovery queries while running."""
+    import threading
+    import time
+
+    from lighthouse_tpu.network.discovery import DiscoveryService, Enr
+
+    t = threading.Thread(target=main, args=(["boot-node", "--run-for", "3"],))
+    t.start()
+    time.sleep(0.5)
+    out = capsys.readouterr().out
+    enr = Enr.from_dict(json.loads(out.strip().splitlines()[0]))
+    d = DiscoveryService(tcp_port=9400, bootnodes=[enr]).start()
+    try:
+        assert d.ping(enr)
+        d.discover()  # registers us at the bootnode
+    finally:
+        d.stop()
+        t.join(timeout=5)
