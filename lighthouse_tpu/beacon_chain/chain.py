@@ -282,11 +282,17 @@ class BeaconChain:
             self._last_finalized_epoch_seen = fin.epoch
             self.event_handler.register_finalized(fin)
 
-    def _justified_state_provider(self, block_root: bytes):
-        state = self._states.get(block_root)
+    def state_for_block_root(self, block_root: bytes):
+        """Post-state of a block: snapshot cache, then store / replay —
+        the one cache-or-load combinator (API routes, justified-balance
+        provider, and the light-client server all use it)."""
+        state = self._states.get(bytes(block_root))
         if state is not None:
             return state
-        return self._load_state_for_block(block_root)
+        return self._load_state_for_block(bytes(block_root))
+
+    def _justified_state_provider(self, block_root: bytes):
+        return self.state_for_block_root(block_root)
 
     def _load_state_for_block(self, block_root: bytes):
         """Fetch a block's post-state: hot/cold store by advertised state

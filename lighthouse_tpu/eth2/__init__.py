@@ -114,6 +114,20 @@ class BeaconNodeHttpClient:
             "application/octet-stream",
         )
 
+    def get_aggregate_attestation_ssz(self, slot: int, data_root: bytes) -> bytes:
+        return self._get(
+            "/eth/v1/validator/aggregate_attestation"
+            f"?slot={int(slot)}&attestation_data_root=0x{bytes(data_root).hex()}",
+            ssz=True,
+        )
+
+    def publish_aggregate_and_proofs_ssz(self, data: bytes) -> int:
+        return self._post(
+            "/eth/v1/validator/aggregate_and_proofs",
+            data,
+            "application/octet-stream",
+        )
+
     def prepare_beacon_proposer(self, preparations: list[dict]) -> int:
         import json as _json
 
@@ -171,11 +185,8 @@ class HttpBeaconNode:
 
     def get_aggregate(self, data):
         try:
-            raw = self.client._get(
-                "/eth/v1/validator/aggregate_attestation"
-                f"?slot={int(data.slot)}"
-                f"&attestation_data_root=0x{data.hash_tree_root().hex()}",
-                ssz=True,
+            raw = self.client.get_aggregate_attestation_ssz(
+                int(data.slot), data.hash_tree_root()
             )
         except ApiClientError as e:
             if e.code == 404:
@@ -184,17 +195,15 @@ class HttpBeaconNode:
         return self.types.Attestation.deserialize(raw)
 
     def publish_aggregates(self, signed_aggregates):
+        """Returns a per-item result list like LocalBeaconNode (HTTP gives
+        one batch status; a 2xx means the batch was accepted)."""
         from ..ssz.core import List as SszList
 
         t = self.types
-        data = SszList[t.SignedAggregateAndProof, 1024].serialize_value(
-            list(signed_aggregates)
-        )
-        return self.client._post(
-            "/eth/v1/validator/aggregate_and_proofs",
-            data,
-            "application/octet-stream",
-        )
+        aggs = list(signed_aggregates)
+        data = SszList[t.SignedAggregateAndProof, 1024].serialize_value(aggs)
+        self.client.publish_aggregate_and_proofs_ssz(data)
+        return [None] * len(aggs)
 
     def prepare_proposers(self, preparations: dict[int, bytes]):
         return self.client.prepare_beacon_proposer(

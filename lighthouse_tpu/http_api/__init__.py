@@ -247,6 +247,55 @@ class BeaconApi:
             )
         return 200
 
+    def light_client_bootstrap_ssz(self, block_root_hex: str) -> bytes:
+        """GET /eth/v1/beacon/light_client/bootstrap/{block_root} (SSZ) —
+        the light-client server surface (beacon API light_client routes;
+        reference serves these from its light-client server cache)."""
+        from ..light_client import create_bootstrap
+
+        try:
+            root = bytes.fromhex(block_root_hex.removeprefix("0x"))
+        except ValueError as e:
+            raise ApiError(400, f"bad block root: {e}") from e
+        chain = self.chain
+        state = chain.state_for_block_root(root)
+        if state is None:
+            raise ApiError(404, "no state for that block root")
+        if getattr(state, "current_sync_committee", None) is None:
+            raise ApiError(404, "pre-Altair state has no light-client data")
+        return create_bootstrap(state, chain.E).serialize()
+
+    def light_client_update_ssz(self) -> bytes:
+        """GET /eth/v1/beacon/light_client/update (SSZ): the latest
+        update — the head block's sync aggregate attesting its parent."""
+        from ..light_client import create_update
+
+        chain = self.chain
+        head_block = chain.head_block()
+        if head_block is None:
+            raise ApiError(404, "no head block")
+        aggregate = getattr(head_block.message.body, "sync_aggregate", None)
+        if aggregate is None:
+            raise ApiError(404, "pre-Altair head has no sync aggregate")
+        attested_root = bytes(head_block.message.parent_root)
+        attested_state = chain.state_for_block_root(attested_root)
+        if attested_state is None:
+            raise ApiError(404, "attested state unavailable")
+        cp = attested_state.finalized_checkpoint
+        finalized_state = None
+        if bytes(cp.root) != b"\x00" * 32:
+            finalized_state = chain.state_for_block_root(bytes(cp.root))
+            if finalized_state is None:
+                raise ApiError(404, "finalized state unavailable")
+        update = create_update(
+            attested_state,
+            finalized_state,
+            aggregate,
+            int(head_block.message.slot),
+            chain.E,
+        )
+        return update.serialize()
+
     def get_aggregate_ssz(self, slot: int, data_root: bytes) -> bytes:
         """GET /eth/v1/validator/aggregate_attestation (SSZ body)."""
         agg = self.chain.op_pool.get_aggregate(data_root)
@@ -428,16 +477,28 @@ class _Handler(BaseHTTPRequestHandler):
             if m:
                 self._send_bytes(self.api.debug_state_ssz(m.group("state_id")))
                 return
+            m = re.match(
+                r"^/eth/v1/beacon/light_client/bootstrap/(?P<root>0x[0-9a-fA-F]+)$",
+                path,
+            )
+            if m:
+                self._send_bytes(
+                    self.api.light_client_bootstrap_ssz(m.group("root"))
+                )
+                return
+            if path == "/eth/v1/beacon/light_client/update":
+                self._send_bytes(self.api.light_client_update_ssz())
+                return
             if path == "/eth/v1/validator/aggregate_attestation":
                 q = parse_qs(parsed.query)
-                self._send_bytes(
-                    self.api.get_aggregate_ssz(
-                        int(q["slot"][0]),
-                        bytes.fromhex(
-                            q["attestation_data_root"][0].removeprefix("0x")
-                        ),
+                try:
+                    slot = int(q["slot"][0])
+                    root = bytes.fromhex(
+                        q["attestation_data_root"][0].removeprefix("0x")
                     )
-                )
+                except (KeyError, ValueError, IndexError) as e:
+                    raise ApiError(400, f"bad query params: {e}") from e
+                self._send_bytes(self.api.get_aggregate_ssz(slot, root))
                 return
             m = re.match(r"^/eth/v3/validator/blocks/(?P<slot>\d+)$", path)
             if m:

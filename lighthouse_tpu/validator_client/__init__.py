@@ -287,11 +287,12 @@ class AttestationService:
         self.node = node
         self.spec = spec
         self.E = E
-        self._last_attested: tuple = (None, None)
-        self._last_attestations: list = []
+        self._last_attested: tuple = (None, None, None)
 
-    def attest(self, slot: int, head_root: bytes) -> list:
-        from ..state_processing import per_slot_processing
+    def _attestation_data(self, state, slot: int, head_root: bytes, committee_index: int):
+        """The duty's AttestationData (validator.md) — one recipe shared
+        by the attest phase and the aggregation phase so the aggregator
+        looks up exactly the data root it attested (or would have)."""
         from ..state_processing.accessors import (
             compute_start_slot_at_epoch,
             get_block_root_at_slot,
@@ -299,9 +300,6 @@ class AttestationService:
         from ..types.containers import build_types
 
         t = build_types(self.E)
-        state = self.node.head_state().copy()
-        while state.slot < slot:
-            per_slot_processing(state, self.spec, self.E)
         epoch = compute_epoch_at_slot(slot, self.E)
         target_slot = compute_start_slot_at_epoch(epoch, self.E)
         target_root = (
@@ -309,6 +307,23 @@ class AttestationService:
             if target_slot >= slot
             else get_block_root_at_slot(state, target_slot, self.E)
         )
+        return t.AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=head_root,
+            source=state.current_justified_checkpoint,
+            target=t.Checkpoint(epoch=epoch, root=target_root),
+        )
+
+    def attest(self, slot: int, head_root: bytes) -> list:
+        from ..state_processing import per_slot_processing
+        from ..types.containers import build_types
+
+        t = build_types(self.E)
+        state = self.node.head_state().copy()
+        while state.slot < slot:
+            per_slot_processing(state, self.spec, self.E)
+        epoch = compute_epoch_at_slot(slot, self.E)
         out = []
         for duty in self.duties.attester_duties(epoch):
             if duty.slot != slot:
@@ -316,12 +331,8 @@ class AttestationService:
             pk = None
             v = state.validators[duty.validator_index]
             pk = bytes(v.pubkey)
-            data = t.AttestationData(
-                slot=slot,
-                index=duty.committee_index,
-                beacon_block_root=head_root,
-                source=state.current_justified_checkpoint,
-                target=t.Checkpoint(epoch=epoch, root=target_root),
+            data = self._attestation_data(
+                state, slot, head_root, duty.committee_index
             )
             try:
                 sig = self.store.sign_attestation(pk, data, state, self.spec, self.E)
@@ -338,8 +349,7 @@ class AttestationService:
         if out:
             self.node.publish_attestations(out)
             inc_counter("vc_attestations_published_total", amount=len(out))
-        self._last_attested = (slot, state)
-        self._last_attestations = out
+        self._last_attested = (slot, state, bytes(head_root))
         return out
 
     def aggregate_if_selected(self, slot: int) -> list:
@@ -351,7 +361,7 @@ class AttestationService:
         from ..beacon_chain.attestation_verification import is_aggregator
         from ..types.containers import build_types
 
-        last_slot, state = getattr(self, "_last_attested", (None, None))
+        last_slot, state, head_root = self._last_attested
         if last_slot != slot or state is None:
             return []
         t = build_types(self.E)
@@ -367,15 +377,12 @@ class AttestationService:
             )
             if not is_aggregator(duty.committee_size, proof, self.E):
                 continue
-            # the data our attest() phase produced for this duty
-            agg = None
-            for att in getattr(self, "_last_attestations", []):
-                if (
-                    att.data.slot == slot
-                    and att.data.index == duty.committee_index
-                ):
-                    agg = self.node.get_aggregate(att.data)
-                    break
+            # rebuild the duty's data directly — aggregation duty holds
+            # even when our own attest was refused (e.g. slashing db)
+            data = self._attestation_data(
+                state, slot, head_root, duty.committee_index
+            )
+            agg = self.node.get_aggregate(data)
             if agg is None:
                 continue
             aap = t.AggregateAndProof(
@@ -391,10 +398,10 @@ class AttestationService:
             )
         if published:
             results = self.node.publish_aggregates(published)
-            accepted = sum(
-                1
-                for r in (results or [])
-                if not isinstance(r, Exception)
+            accepted = (
+                sum(1 for r in results if not isinstance(r, Exception))
+                if isinstance(results, list)
+                else len(published)  # batch-status transports
             )
             inc_counter("vc_aggregates_published_total", amount=accepted)
         return published

@@ -234,3 +234,48 @@ def test_sync_committee_period_rollover(chain):
     assert _period(store.finalized_header.beacon.slot, E_) >= 1
     # rotation happened: current is the previously stored next
     assert store.current_sync_committee == old_next
+
+
+def test_light_client_http_routes():
+    """Served over the Beacon API: a light client bootstraps from the
+    /light_client/bootstrap route and advances its store with the
+    /light_client/update route — full server+client loop over HTTP."""
+    import urllib.request
+
+    from lighthouse_tpu.http_api import HttpApiServer
+    from lighthouse_tpu.light_client import build_light_client_types
+
+    bls.set_backend("host")
+    try:
+        spec = replace(minimal_spec(), altair_fork_epoch=0)
+        h = BeaconChainHarness(spec, E, validator_count=8)
+        h.extend_chain(3 * E.SLOTS_PER_EPOCH)
+        srv = HttpApiServer(h.chain).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            fin_root = bytes(h.chain.finalized_checkpoint.root)
+            assert fin_root != b"\x00" * 32
+            raw = urllib.request.urlopen(
+                f"{base}/eth/v1/beacon/light_client/bootstrap/0x{fin_root.hex()}",
+                timeout=10,
+            ).read()
+            lt = build_light_client_types(E)
+            boot = lt.LightClientBootstrap.deserialize(raw)
+            store = initialize_light_client_store(fin_root, boot, E)
+            raw = urllib.request.urlopen(
+                f"{base}/eth/v1/beacon/light_client/update", timeout=10
+            ).read()
+            update = lt.LightClientUpdate.deserialize(raw)
+            process_light_client_update(
+                store,
+                update,
+                current_slot=int(h.chain.head_state.slot) + 1,
+                genesis_validators_root=bytes(h.chain.genesis_validators_root),
+                spec=spec,
+                E=E,
+            )
+            assert store.optimistic_header.beacon.slot >= boot.header.beacon.slot
+        finally:
+            srv.stop()
+    finally:
+        bls.set_backend("fake_crypto")
