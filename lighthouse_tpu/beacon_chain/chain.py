@@ -498,6 +498,7 @@ class BeaconChain:
         # Deneb availability gate (beacon_chain.rs → data_availability_checker):
         # commitment-carrying blocks need all sidecars KZG-verified first.
         commitments = getattr(block.body, "blob_kzg_commitments", None)
+        imported_blobs = None
         if commitments:
             from .data_availability import AvailabilityCheckError
 
@@ -511,6 +512,7 @@ class BeaconChain:
                 raise BlockError(
                     "blobs unavailable: feed sidecars via process_blob_sidecars"
                 )
+            imported_blobs = avail.blobs
 
         ctxt = ConsensusContext(block.slot)
         if (
@@ -562,6 +564,10 @@ class BeaconChain:
 
         self.store.put_block(block_root, signed_block)
         self.store.put_state(block.state_root, state)
+        if imported_blobs:
+            # verified sidecars persist with the block so the node can
+            # serve BlobSidecarsByRange/Root for the DA window
+            self.store.put_blob_sidecars(block_root, imported_blobs)
         self._states[block_root] = state
         self._blocks_by_root[block_root] = signed_block
         self.block_times_cache.set_imported(
@@ -719,10 +725,22 @@ class BeaconChain:
                 # canonical ancestor of the finalized checkpoint → cold DB
                 migrated.append(root)
             else:
-                # pruned fork: drop entirely
+                # pruned fork: drop entirely (incl. any staged sidecars)
                 self._blocks_by_root.pop(root, None)
+                self.store.delete_blob_sidecars(root)
         if migrated:
             self.store.migrate_to_cold(finalized_slot, migrated)
+        # blob retention: drop sidecars of pruned forks and of canonical
+        # blocks aged out of the DA window (deneb p2p
+        # MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS)
+        da_epochs = getattr(
+            self.spec, "min_epochs_for_blob_sidecars_requests", 4096
+        )
+        da_cutoff = finalized_slot - da_epochs * self.E.SLOTS_PER_EPOCH
+        for root in self.store.blob_sidecar_roots():
+            blk = self._signed_block(root)
+            if blk is None or blk.message.slot < da_cutoff:
+                self.store.delete_blob_sidecars(root)
         self.observed_attesters.prune(finalized.epoch)
         self.observed_aggregators.prune(finalized.epoch)
         self.observed_block_producers.prune(finalized_slot)  # keyed by slot

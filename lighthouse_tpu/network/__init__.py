@@ -300,6 +300,7 @@ class SyncManager:
             )
             if not blocks:
                 break
+            self._couple_blobs(peer, blocks)
             result = chain.process_chain_segment(blocks)
             imported_total += result.imported
             inc_counter("sync_blocks_imported_total", amount=result.imported)
@@ -309,6 +310,40 @@ class SyncManager:
             if result.imported == 0:
                 break
         return imported_total
+
+    def _couple_blobs(self, peer: Peer, blocks):
+        """Block/sidecar coupling (sync/block_sidecar_coupling.rs):
+        commitment-carrying range blocks need their sidecars staged in the
+        DA checker before the segment can import."""
+        chain = self.service.chain
+        wanted = []
+        for signed in blocks:
+            commitments = getattr(
+                signed.message.body, "blob_kzg_commitments", None
+            )
+            if commitments:
+                root = signed.message.hash_tree_root()
+                for i in range(len(commitments)):
+                    wanted.append(
+                        M.BlobIdentifier(block_root=root, index=i)
+                    )
+        if not wanted:
+            return
+        t = chain.types
+        sidecars = peer.client.blob_sidecars_by_root(
+            wanted, t.BlobSidecar.deserialize
+        )
+        by_root: dict[bytes, list] = {}
+        for sc in sidecars:
+            r = sc.signed_block_header.message.hash_tree_root()
+            by_root.setdefault(r, []).append(sc)
+        for root, scs in by_root.items():
+            try:
+                chain.process_blob_sidecars(root, scs)
+            except Exception:  # noqa: BLE001 — bad sidecar: penalize, move on
+                self.service.peers.report(peer.peer_id, SCORE_INVALID_MESSAGE)
+                # the affected block then fails its DA gate in the segment
+                # import, which reports the batch outcome normally
 
 
 def _verify_backfill_signatures(blocks, chain) -> bool:
@@ -389,6 +424,7 @@ class NetworkService:
         self.topic_sync_committee = M.gossip_topic(
             digest, M.TOPIC_SYNC_COMMITTEE
         )
+        self.topic_blob_sidecar = M.gossip_topic(digest, M.TOPIC_BLOB_SIDECAR)
         self.gossip.subscribe(self.topic_block, self._on_gossip_block)
         self.gossip.subscribe(self.topic_att, self._on_gossip_attestation)
         self.gossip.subscribe(self.topic_aggregate, self._on_gossip_aggregate)
@@ -401,6 +437,9 @@ class NetworkService:
         )
         self.gossip.subscribe(
             self.topic_sync_committee, self._on_gossip_sync_committee
+        )
+        self.gossip.subscribe(
+            self.topic_blob_sidecar, self._on_gossip_blob_sidecar
         )
 
     # -- lifecycle -------------------------------------------------------------
@@ -612,6 +651,20 @@ class NetworkService:
         msg = t.SyncCommitteeMessage.deserialize(data)
         self.chain.process_sync_committee_message(msg)
 
+    def _on_gossip_blob_sidecar(self, data: bytes):
+        """KZG-verify and stage a gossiped sidecar; when this sidecar
+        completes a staged block's set, import that block NOW — its own
+        gossip arrived earlier, failed the DA gate, and is dedup'd by the
+        seen-cache, so nothing else will retry it."""
+        t = self.chain.types
+        sc = t.BlobSidecar.deserialize(data)
+        block_root = sc.signed_block_header.message.hash_tree_root()
+        avail = self.chain.process_blob_sidecars(block_root, [sc])
+        if avail.available and not self.chain.fork_choice.contains_block(
+            block_root
+        ):
+            self.chain.process_block(avail.block)
+
     # -- publishing -------------------------------------------------------------
 
     def publish_block(self, signed_block):
@@ -638,12 +691,20 @@ class NetworkService:
     def publish_sync_committee_message(self, message):
         self.gossip.publish(self.topic_sync_committee, message.serialize())
 
+    def publish_blob_sidecar(self, sidecar):
+        self.gossip.publish(self.topic_blob_sidecar, sidecar.serialize())
+
     # -- RPC server data providers ----------------------------------------------
 
     def blocks_by_range(self, start_slot: int, count: int):
-        out = []
+        return [signed for _root, signed in self._blocks_by_range_with_roots(
+            start_slot, count
+        )]
+
+    def _blocks_by_range_with_roots(self, start_slot: int, count: int):
+        """Canonical chain walk from head backwards (store-backed); each
+        block's root comes free from the walk — never re-hashed."""
         chain = self.chain
-        # canonical chain walk from head backwards (store-backed)
         root = chain.head_root
         wanted = range(int(start_slot), int(start_slot) + int(count))
         found = {}
@@ -655,11 +716,9 @@ class NetworkService:
             if slot < int(start_slot):
                 break
             if slot in wanted:
-                found[slot] = signed
+                found[slot] = (bytes(root), signed)
             root = signed.message.parent_root
-        for slot in sorted(found):
-            out.append(found[slot])
-        return out
+        return [found[slot] for slot in sorted(found)]
 
     def blocks_by_root(self, roots: list):
         out = []
@@ -669,4 +728,24 @@ class NetworkService:
             )
             if signed is not None:
                 out.append(signed)
+        return out
+
+    def blob_sidecars_by_range(self, start_slot: int, count: int):
+        """Sidecars for canonical blocks in [start, start+count) in
+        (slot, index) order (deneb/p2p BlobSidecarsByRange)."""
+        out = []
+        for root, _signed in self._blocks_by_range_with_roots(start_slot, count):
+            out.extend(self.chain.store.get_blob_sidecars(root))
+        return out
+
+    def blob_sidecars_by_root(self, blob_ids: list):
+        out = []
+        by_root: dict[bytes, list] = {}
+        for bid in blob_ids:
+            root = bytes(bid.block_root)
+            if root not in by_root:
+                by_root[root] = self.chain.store.get_blob_sidecars(root)
+            for sc in by_root[root]:
+                if int(sc.index) == int(bid.index):
+                    out.append(sc)
         return out

@@ -46,6 +46,22 @@ class BlocksByRootRequest(Container):
     roots: List[Bytes32, 1024]
 
 
+class BlobsByRangeRequest(Container):
+    """BlobSidecarsByRange (deneb/p2p-interface.md)."""
+
+    start_slot: uint64
+    count: uint64
+
+
+class BlobIdentifier(Container):
+    block_root: Bytes32
+    index: uint64
+
+
+class BlobsByRootRequest(Container):
+    blob_ids: List[BlobIdentifier, 1024]
+
+
 GOODBYE_CLIENT_SHUTDOWN = 1
 GOODBYE_IRRELEVANT_NETWORK = 2
 GOODBYE_FAULT = 3
@@ -73,6 +89,10 @@ PROTO_PING = "/eth2/beacon_chain/req/ping/1/ssz_snappy"
 PROTO_METADATA = "/eth2/beacon_chain/req/metadata/2/ssz_snappy"
 PROTO_BLOCKS_BY_RANGE = "/eth2/beacon_chain/req/beacon_blocks_by_range/2/ssz_snappy"
 PROTO_BLOCKS_BY_ROOT = "/eth2/beacon_chain/req/beacon_blocks_by_root/2/ssz_snappy"
+PROTO_BLOBS_BY_RANGE = (
+    "/eth2/beacon_chain/req/blob_sidecars_by_range/1/ssz_snappy"
+)
+PROTO_BLOBS_BY_ROOT = "/eth2/beacon_chain/req/blob_sidecars_by_root/1/ssz_snappy"
 PROTO_GOSSIP = "/lighthouse_tpu/gossip/1"  # persistent pub/sub stream
 
 TOPIC_BEACON_BLOCK = "beacon_block"
@@ -82,3 +102,4 @@ TOPIC_VOLUNTARY_EXIT = "voluntary_exit"
 TOPIC_PROPOSER_SLASHING = "proposer_slashing"
 TOPIC_ATTESTER_SLASHING = "attester_slashing"
 TOPIC_SYNC_COMMITTEE = "sync_committee_0"
+TOPIC_BLOB_SIDECAR = "blob_sidecar_0"

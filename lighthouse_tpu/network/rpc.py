@@ -185,6 +185,19 @@ class RpcServer:
             for signed in node.blocks_by_root(list(req.roots)):
                 self._respond(sock, RESP_SUCCESS, signed.serialize())
             sock.shutdown(socket.SHUT_WR)
+        elif proto == M.PROTO_BLOBS_BY_RANGE:
+            req = M.BlobsByRangeRequest.deserialize(_recv_block(sock))
+            if req.count > MAX_REQUEST_BLOCKS:
+                self._respond(sock, RESP_INVALID_REQUEST, b"")
+                return
+            for sc in node.blob_sidecars_by_range(req.start_slot, req.count):
+                self._respond(sock, RESP_SUCCESS, sc.serialize())
+            sock.shutdown(socket.SHUT_WR)
+        elif proto == M.PROTO_BLOBS_BY_ROOT:
+            req = M.BlobsByRootRequest.deserialize(_recv_block(sock))
+            for sc in node.blob_sidecars_by_root(list(req.blob_ids)):
+                self._respond(sock, RESP_SUCCESS, sc.serialize())
+            sock.shutdown(socket.SHUT_WR)
         else:
             self._respond(sock, RESP_INVALID_REQUEST, b"")
 
@@ -274,4 +287,16 @@ class RpcClient:
         req = M.BlocksByRootRequest(roots=roots)
         return self._stream_blocks(
             M.PROTO_BLOCKS_BY_ROOT, req.serialize(), decode_block
+        )
+
+    def blob_sidecars_by_range(self, start_slot: int, count: int, decode_sidecar):
+        req = M.BlobsByRangeRequest(start_slot=start_slot, count=count)
+        return self._stream_blocks(
+            M.PROTO_BLOBS_BY_RANGE, req.serialize(), decode_sidecar
+        )
+
+    def blob_sidecars_by_root(self, blob_ids: list, decode_sidecar):
+        req = M.BlobsByRootRequest(blob_ids=blob_ids)
+        return self._stream_blocks(
+            M.PROTO_BLOBS_BY_ROOT, req.serialize(), decode_sidecar
         )

@@ -115,6 +115,40 @@ class HotColdDB:
         cold blocks are finalized and must never be deleted)."""
         self.hot.delete(DBColumn.BEACON_BLOCK, block_root)
 
+    # -- blob sidecars (Deneb DA history; served via BlobsByRange/Root) ----
+
+    def put_blob_sidecars(self, block_root: bytes, sidecars: list):
+        """All of one block's verified sidecars under its root (the
+        reference stores the sidecar list per block in its blobs DB).
+        BlobSidecar has a single fork-independent layout — length-prefixed
+        concat, no fork tag."""
+        if not sidecars:
+            return
+        parts = []
+        for sc in sidecars:
+            data = sc.serialize()
+            parts.append(len(data).to_bytes(4, "little") + data)
+        self.hot.put(DBColumn.BLOB_SIDECARS, block_root, b"".join(parts))
+
+    def delete_blob_sidecars(self, block_root: bytes):
+        self.hot.delete(DBColumn.BLOB_SIDECARS, block_root)
+
+    def blob_sidecar_roots(self):
+        return list(self.hot.keys(DBColumn.BLOB_SIDECARS))
+
+    def get_blob_sidecars(self, block_root: bytes) -> list:
+        data = self.hot.get(DBColumn.BLOB_SIDECARS, block_root)
+        if data is None:
+            return []
+        out = []
+        pos = 0
+        while pos < len(data):
+            n = int.from_bytes(data[pos : pos + 4], "little")
+            pos += 4
+            out.append(self.types.BlobSidecar.deserialize(data[pos : pos + n]))
+            pos += n
+        return out
+
     def block_exists(self, block_root: bytes) -> bool:
         return self.hot.exists(DBColumn.BEACON_BLOCK, block_root) or self.cold.exists(
             DBColumn.BEACON_BLOCK, block_root

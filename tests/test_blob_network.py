@@ -1,0 +1,158 @@
+"""Blob sidecar persistence + p2p serving (Deneb DA networking).
+
+Store roundtrip (BLOB_SIDECARS column), BlobSidecarsByRange/Root RPC
+between two nodes, gossip sidecar staging into the DA checker
+(deneb/p2p-interface.md; reference sync/block_sidecar_coupling.rs)."""
+
+import random
+import time
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.kzg import FR_MODULUS, Kzg, TrustedSetup
+from lighthouse_tpu.network import NetworkService
+from lighthouse_tpu.network import messages as M
+from lighthouse_tpu.ssz.merkle_proof import build_blob_sidecars
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.containers import build_types
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+T = build_types(E)
+
+
+@pytest.fixture(scope="module")
+def kzg():
+    return Kzg(TrustedSetup.insecure_dev(E.FIELD_ELEMENTS_PER_BLOB))
+
+
+def _blob(seed, n=E.FIELD_ELEMENTS_PER_BLOB):
+    rng = random.Random(seed)
+    return b"".join(
+        rng.randrange(FR_MODULUS).to_bytes(32, "big") for _ in range(n)
+    )
+
+
+def _sidecars(kzg, seed=1, n_blobs=2, slot=5):
+    blobs = [_blob(seed + i) for i in range(n_blobs)]
+    commitments = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+    body = T.BeaconBlockBodyDeneb(blob_kzg_commitments=commitments)
+    block = T.BeaconBlockDeneb(slot=slot, proposer_index=0, body=body)
+    signed = T.SignedBeaconBlockDeneb(message=block, signature=b"\x00" * 96)
+    return signed, build_blob_sidecars(signed, blobs, kzg, E)
+
+
+def _harness():
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    return BeaconChainHarness(spec, E, validator_count=16)
+
+
+def test_store_blob_sidecar_roundtrip(kzg):
+    h = _harness()
+    signed, sidecars = _sidecars(kzg)
+    root = signed.message.hash_tree_root()
+    h.chain.store.put_blob_sidecars(root, sidecars)
+    got = h.chain.store.get_blob_sidecars(root)
+    assert len(got) == 2
+    assert [bytes(s.kzg_commitment) for s in got] == [
+        bytes(s.kzg_commitment) for s in sidecars
+    ]
+    assert got[0].serialize() == sidecars[0].serialize()
+    assert h.chain.store.get_blob_sidecars(b"\x77" * 32) == []
+
+
+def test_blob_rpc_by_root_and_range(kzg):
+    a = _harness()
+    a.extend_chain(2)
+    b = _harness()
+    na = NetworkService(a.chain).start()
+    nb = NetworkService(b.chain).start()
+    try:
+        # stash sidecars under A's head block root (the canonical chain
+        # walk serves them for its slot range)
+        head_root = a.chain.head_root
+        _signed, sidecars = _sidecars(kzg, slot=a.chain.head_state.slot)
+        a.chain.store.put_blob_sidecars(head_root, sidecars)
+
+        peer = nb.connect("127.0.0.1", na.port)
+        ids = [
+            M.BlobIdentifier(block_root=head_root, index=i) for i in range(2)
+        ]
+        got = peer.client.blob_sidecars_by_root(ids, T.BlobSidecar.deserialize)
+        assert len(got) == 2
+        assert [int(s.index) for s in got] == [0, 1]
+
+        got = peer.client.blob_sidecars_by_range(
+            1, a.chain.head_state.slot, T.BlobSidecar.deserialize
+        )
+        assert len(got) == 2  # only the head block has sidecars
+    finally:
+        na.stop()
+        nb.stop()
+
+
+def test_sidecar_completion_triggers_block_import(kzg):
+    """A block that failed its DA gate (arrived before its last sidecar)
+    must be imported the moment the completing sidecar lands — gossip
+    dedup means nobody will re-send the block."""
+    from lighthouse_tpu.beacon_chain.data_availability import Availability
+
+    h = _harness()
+    na = NetworkService(h.chain).start()
+    try:
+        signed, sidecars = _sidecars(kzg, seed=4)
+        imported = []
+        h.chain.process_blob_sidecars = lambda root, scs: Availability(
+            available=True, block=signed, blobs=scs
+        )
+        h.chain.process_block = lambda blk: imported.append(blk)
+        na._on_gossip_blob_sidecar(sidecars[0].serialize())
+        assert imported == [signed]
+        # already-known blocks are not re-imported
+        imported.clear()
+        h.chain.fork_choice.contains_block = lambda root: True
+        na._on_gossip_blob_sidecar(sidecars[0].serialize())
+        assert imported == []
+    finally:
+        na.stop()
+
+
+def test_blob_pruning_at_finality(kzg):
+    """Sidecars of pruned forks and DA-window-expired blocks are deleted
+    when finality advances."""
+    h = _harness()
+    signed, sidecars = _sidecars(kzg, seed=6)
+    fork_root = signed.message.hash_tree_root()
+    h.chain.store.put_blob_sidecars(fork_root, sidecars)
+    assert h.chain.store.get_blob_sidecars(fork_root)
+    # drive to finality: the orphan root (no block known) gets pruned
+    h.extend_chain(4 * E.SLOTS_PER_EPOCH)
+    assert h.chain.finalized_checkpoint.epoch >= 1
+    assert h.chain.store.get_blob_sidecars(fork_root) == []
+
+
+def test_gossip_blob_sidecar_stages_da(kzg):
+    a = _harness()
+    a.chain.data_availability_checker.kzg = kzg
+    b = _harness()
+    b.chain.data_availability_checker.kzg = kzg
+    na = NetworkService(a.chain).start()
+    nb = NetworkService(b.chain).start()
+    try:
+        nb.connect("127.0.0.1", na.port)
+        time.sleep(0.2)
+        signed, sidecars = _sidecars(kzg, seed=9)
+        nb.publish_blob_sidecar(sidecars[0])
+        root = signed.message.hash_tree_root()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if a.chain.data_availability_checker.has_pending(root):
+                break
+            time.sleep(0.05)
+        assert a.chain.data_availability_checker.has_pending(root)
+    finally:
+        na.stop()
+        nb.stop()
