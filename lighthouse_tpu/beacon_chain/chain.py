@@ -118,6 +118,9 @@ class BeaconChain:
         # prepare_beacon_proposer registrations: validator index → fee
         # recipient, consulted when building payload attributes
         self.proposer_preparations: dict[int, bytes] = {}
+        # attached by SlasherService (slasher/service feeds off the
+        # chain's verified objects); None = no slasher running
+        self.slasher_service = None
         # gossip reader threads, the VC, and sync all mutate the chain
         # concurrently; imports serialize on a loud-failure lock
         # (timeout_rw_lock.rs — starvation raises instead of deadlocking)
@@ -499,6 +502,12 @@ class BeaconChain:
         # commitment-carrying blocks need all sidecars KZG-verified first.
         commitments = getattr(block.body, "blob_kzg_commitments", None)
         imported_blobs = None
+        if commitments and not self.block_within_da_window(
+            block.slot, current_slot
+        ):
+            # outside the retention window peers have pruned the sidecars;
+            # the spec imports such blocks without the DA gate
+            commitments = None
         if commitments:
             from .data_availability import AvailabilityCheckError
 
@@ -558,6 +567,8 @@ class BeaconChain:
         for att in block.body.attestations:
             try:
                 indexed = ctxt.get_indexed_attestation(state, att, self.E)
+                if self.slasher_service is not None:
+                    self.slasher_service.observe_indexed_attestation(indexed)
                 self.fork_choice.on_attestation(indexed, is_from_block=True)
             except Exception:
                 continue  # fork-choice-irrelevant attestations are skipped
@@ -574,6 +585,8 @@ class BeaconChain:
             block_root, block.slot, time.monotonic()
         )
         self.event_handler.register_block(block_root, block.slot)
+        if self.slasher_service is not None:
+            self.slasher_service.observe_block(signed_block)
         self.validator_monitor.process_block(
             block, block.proposer_index, state, self.spec
         )
@@ -733,13 +746,14 @@ class BeaconChain:
         # blob retention: drop sidecars of pruned forks and of canonical
         # blocks aged out of the DA window (deneb p2p
         # MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS)
-        da_epochs = getattr(
-            self.spec, "min_epochs_for_blob_sidecars_requests", 4096
-        )
-        da_cutoff = finalized_slot - da_epochs * self.E.SLOTS_PER_EPOCH
-        for root in self.store.blob_sidecar_roots():
-            blk = self._signed_block(root)
-            if blk is None or blk.message.slot < da_cutoff:
+        da_cutoff = finalized_slot - self.da_window_slots()
+        for root, sc_slot in self.store.blob_sidecar_entries():
+            # age check from the slot prefix; orphan check via cheap
+            # existence lookups (no decode on either path)
+            if sc_slot < da_cutoff or (
+                root not in self._blocks_by_root
+                and not self.store.block_exists(root)
+            ):
                 self.store.delete_blob_sidecars(root)
         self.observed_attesters.prune(finalized.epoch)
         self.observed_aggregators.prune(finalized.epoch)
@@ -804,6 +818,17 @@ class BeaconChain:
         with self.import_lock.acquire_write():
             self.op_pool.insert_attester_slashing(slashing)
 
+    def da_window_slots(self) -> int:
+        return (
+            getattr(self.spec, "min_epochs_for_blob_sidecars_requests", 4096)
+            * self.E.SLOTS_PER_EPOCH
+        )
+
+    def block_within_da_window(self, block_slot: int, current_slot: int) -> bool:
+        """deneb fork-choice: blob availability is only required inside
+        the sidecar retention window."""
+        return int(block_slot) >= int(current_slot) - self.da_window_slots()
+
     def get_aggregated_attestation(self, data):
         """Pool aggregate for an AttestationData (the
         /eth/v1/validator/aggregate_attestation surface)."""
@@ -828,9 +853,24 @@ class BeaconChain:
 
     def process_blob_sidecars(self, block_root: bytes, sidecars: list):
         """KZG-verify and stage blob sidecars for a block (gossip/RPC blobs
-        path → data_availability_checker.put_blobs)."""
+        path → data_availability_checker.put_blobs). The sidecar header's
+        proposer signature is verified first — without it anyone could
+        flood the pending dict with self-consistent KZG data under
+        fabricated headers (gossip condition: valid header signature)."""
         from .data_availability import AvailabilityCheckError
 
+        for sc in sidecars:
+            header = getattr(sc, "signed_block_header", None)
+            if header is None:
+                continue
+            try:
+                ok = sigsets.block_header_signature_set(
+                    self.head_state, header, self.spec, self.E
+                ).verify()
+            except (IndexError, KeyError, ValueError) as e:
+                raise BlockError(f"blob sidecar header malformed: {e}") from e
+            if not ok:
+                raise BlockError("blob sidecar header signature invalid")
         try:
             return self.data_availability_checker.put_blobs(
                 block_root, sidecars, slot=self.slot_clock.now()
@@ -861,6 +901,8 @@ class BeaconChain:
         return verified
 
     def apply_attestation_to_fork_choice(self, indexed):
+        if self.slasher_service is not None:
+            self.slasher_service.observe_indexed_attestation(indexed)
         try:
             self.fork_choice.on_attestation(indexed, is_from_block=False)
         except Exception:

@@ -42,10 +42,26 @@ class Availability:
 
 
 class DataAvailabilityChecker:
+    #: staged component sets are bounded (each can hold MAX_BLOBS × 128KiB;
+    #: a flood of unique roots must not grow memory without bound)
+    MAX_PENDING = 64
+
     def __init__(self, kzg, E):
         self.kzg = kzg
         self.E = E
         self._pending: dict[bytes, PendingComponents] = {}
+
+    def _bounded_entry(self, block_root: bytes) -> PendingComponents:
+        pend = self._pending.get(block_root)
+        if pend is None:
+            if len(self._pending) >= self.MAX_PENDING:
+                oldest = min(
+                    self._pending, key=lambda r: self._pending[r].inserted_at_slot
+                )
+                self._pending.pop(oldest)
+            pend = PendingComponents()
+            self._pending[block_root] = pend
+        return pend
 
     # -- sidecar verification -------------------------------------------------
 
@@ -82,14 +98,14 @@ class DataAvailabilityChecker:
 
     def put_blobs(self, block_root: bytes, sidecars: list, slot: int = 0) -> Availability:
         self.verify_blob_sidecars(sidecars, block_root)
-        pend = self._pending.setdefault(block_root, PendingComponents())
+        pend = self._bounded_entry(block_root)
         pend.inserted_at_slot = max(pend.inserted_at_slot, slot)
         for sc in sidecars:
             pend.blobs[int(sc.index)] = sc
         return self.check_availability(block_root)
 
     def put_block(self, block_root: bytes, signed_block, slot: int = 0) -> Availability:
-        pend = self._pending.setdefault(block_root, PendingComponents())
+        pend = self._bounded_entry(block_root)
         pend.inserted_at_slot = max(pend.inserted_at_slot, slot)
         pend.block = signed_block
         return self.check_availability(block_root)

@@ -38,6 +38,7 @@ class ClientConfig:
     manual_slot_clock: bool = True  # tests drive slots by hand
     genesis_state: object = None  # checkpoint-sync style provided state
     genesis_time: int = 1_600_000_000
+    slasher: bool = False  # run the in-process slashing detector
 
 
 class Client:
@@ -72,6 +73,8 @@ class Client:
         if self.state_advance is not None:
             # pre-build next slot's state off the (possibly new) head
             self.state_advance.on_slot_tick(slot)
+        if self.chain.slasher_service is not None:
+            self.chain.slasher_service.on_slot(slot)
         set_gauge("beacon_head_slot", self.chain.head_state.slot)
 
     def stop(self):
@@ -158,6 +161,11 @@ class ClientBuilder:
             from ..validator_client import ValidatorClient
 
             c.vc = ValidatorClient(c.chain, c.keypairs, cfg.spec, cfg.E)
+        # slasher (slasher/service feeds off the chain's verified objects)
+        if cfg.slasher:
+            from ..slasher.service import SlasherService
+
+            SlasherService(c.chain)  # attaches itself as chain.slasher_service
         # timer + next-slot pre-advance (state_advance_timer.rs)
         from ..beacon_chain.state_advance import StateAdvanceTimer
 

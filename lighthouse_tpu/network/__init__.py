@@ -317,10 +317,15 @@ class SyncManager:
         DA checker before the segment can import."""
         chain = self.service.chain
         wanted = []
+        now = chain.slot_clock.now()
         for signed in blocks:
             commitments = getattr(
                 signed.message.body, "blob_kzg_commitments", None
             )
+            if commitments and not chain.block_within_da_window(
+                signed.message.slot, now
+            ):
+                continue  # peers have pruned these; import skips the gate
             if commitments:
                 root = signed.message.hash_tree_root()
                 for i in range(len(commitments)):
@@ -609,7 +614,19 @@ class NetworkService:
 
     def _on_gossip_block(self, data: bytes):
         signed = self.decode_block(data)
-        self.chain.process_block(signed)
+        try:
+            self.chain.process_block(signed)
+        except Exception as e:  # noqa: BLE001
+            if "blobs unavailable" in str(e):
+                # expected ordering race, not peer fault: the block is
+                # staged in the DA checker; the completing sidecar's
+                # handler imports it (no downscore for the forwarder)
+                log.info(
+                    "block waiting on sidecars",
+                    slot=signed.message.slot,
+                )
+                return
+            raise
         log.info(
             "gossip block imported",
             slot=signed.message.slot,

@@ -26,6 +26,7 @@ RESP_SERVER_ERROR = 2
 
 MAX_PAYLOAD = 1 << 22  # 4 MiB cap (gossip_max_size class bound)
 MAX_REQUEST_BLOCKS = 1024
+MAX_REQUEST_BLOB_SIDECARS = 768  # deneb p2p: 128 blocks × 6 blobs
 
 
 class RpcError(RuntimeError):
@@ -187,7 +188,10 @@ class RpcServer:
             sock.shutdown(socket.SHUT_WR)
         elif proto == M.PROTO_BLOBS_BY_RANGE:
             req = M.BlobsByRangeRequest.deserialize(_recv_block(sock))
-            if req.count > MAX_REQUEST_BLOCKS:
+            # blob responses are ~128KiB each — the spec bounds this
+            # protocol by sidecar count (MAX_REQUEST_BLOB_SIDECARS), not
+            # block count
+            if req.count * 6 > MAX_REQUEST_BLOB_SIDECARS:
                 self._respond(sock, RESP_INVALID_REQUEST, b"")
                 return
             for sc in node.blob_sidecars_by_range(req.start_slot, req.count):

@@ -1,0 +1,72 @@
+"""Slasher service: bridges the chain into the slashing detector.
+
+The slasher/service crate analog: subscribes the slasher to everything
+the node verifies (gossip/block attestations as IndexedAttestations,
+block headers), drives `process_queued` once per epoch, and injects any
+found slashings into the operation pool so the node's own proposals
+carry the proofs (service/src/lib.rs feeds the op pool the same way)."""
+
+from __future__ import annotations
+
+from ..metrics import inc_counter
+from ..utils.logging import get_logger
+from . import Slasher
+
+log = get_logger("slasher.service")
+
+
+class SlasherService:
+    def __init__(self, chain, slasher: Slasher | None = None):
+        self.chain = chain
+        self.slasher = slasher or Slasher(chain.E)
+        self._last_processed_epoch = -1
+        # hook into the chain's verification paths
+        chain.slasher_service = self
+
+    # -- chain feed (called by the chain on verified objects) ------------
+
+    def observe_indexed_attestation(self, indexed):
+        self.slasher.accept_attestation(indexed)
+
+    def observe_block(self, signed_block):
+        """Feed the proposal as a signed header (block queues track
+        double proposals per slot)."""
+        t = self.chain.types
+        m = signed_block.message
+        header = t.BeaconBlockHeader(
+            slot=m.slot,
+            proposer_index=m.proposer_index,
+            parent_root=m.parent_root,
+            state_root=m.state_root,
+            body_root=m.body.hash_tree_root(),
+        )
+        self.slasher.accept_block_header(
+            t.SignedBeaconBlockHeader(
+                message=header, signature=signed_block.signature
+            )
+        )
+
+    # -- periodic processing ---------------------------------------------
+
+    def on_slot(self, slot: int):
+        epoch = slot // self.chain.E.SLOTS_PER_EPOCH
+        if epoch <= self._last_processed_epoch:
+            return
+        self._last_processed_epoch = epoch
+        stats = self.slasher.process_queued(epoch)
+        atts, props = self.slasher.drain_slashings()
+        for kind, slashings, process in (
+            ("attester", atts, self.chain.process_attester_slashing),
+            ("proposer", props, self.chain.process_proposer_slashing),
+        ):
+            for slashing in slashings:
+                try:
+                    process(slashing)
+                except Exception as e:  # noqa: BLE001 — e.g. already slashed
+                    log.warning(
+                        "found slashing not poolable", kind=kind, error=repr(e)
+                    )
+                    continue
+                inc_counter("slasher_slashings_found_total", kind=kind)
+                log.warning("slashing detected and pooled", kind=kind)
+        return stats

@@ -124,7 +124,10 @@ class HotColdDB:
         concat, no fork tag."""
         if not sidecars:
             return
-        parts = []
+        # 8-byte slot prefix: retention expiry reads ONLY this (never a
+        # block or sidecar decode)
+        slot = int(sidecars[0].signed_block_header.message.slot)
+        parts = [slot.to_bytes(8, "little")]
         for sc in sidecars:
             data = sc.serialize()
             parts.append(len(data).to_bytes(4, "little") + data)
@@ -133,15 +136,22 @@ class HotColdDB:
     def delete_blob_sidecars(self, block_root: bytes):
         self.hot.delete(DBColumn.BLOB_SIDECARS, block_root)
 
-    def blob_sidecar_roots(self):
-        return list(self.hot.keys(DBColumn.BLOB_SIDECARS))
+    def blob_sidecar_entries(self) -> list[tuple[bytes, int]]:
+        """(block_root, slot) per stored sidecar set — slot from the
+        8-byte prefix, no SSZ decode."""
+        out = []
+        for root in self.hot.keys(DBColumn.BLOB_SIDECARS):
+            data = self.hot.get(DBColumn.BLOB_SIDECARS, root)
+            if data and len(data) >= 8:
+                out.append((root, int.from_bytes(data[:8], "little")))
+        return out
 
     def get_blob_sidecars(self, block_root: bytes) -> list:
         data = self.hot.get(DBColumn.BLOB_SIDECARS, block_root)
         if data is None:
             return []
         out = []
-        pos = 0
+        pos = 8  # skip slot prefix
         while pos < len(data):
             n = int.from_bytes(data[pos : pos + 4], "little")
             pos += 4

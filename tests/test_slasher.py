@@ -131,3 +131,38 @@ def test_detected_slashing_applies_in_state_transition():
     assert slashings
     process_attester_slashing(state, slashings[0], spec, E, verify_signatures=False)
     assert state.validators[3].slashed
+
+
+def test_slasher_service_end_to_end():
+    """SlasherService (slasher/service analog): a double vote observed on
+    the live chain is detected at the epoch tick and the slashing lands
+    in the op pool — then in a produced block."""
+    from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+    from lighthouse_tpu.slasher.service import SlasherService
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, E, validator_count=16)
+    svc = SlasherService(h.chain)
+    assert h.chain.slasher_service is svc
+    h.extend_chain(2 * E.SLOTS_PER_EPOCH)  # normal life: nothing slashable
+
+    # equivocation: validator 3 votes twice for the same target epoch
+    epoch = 1
+    a1 = _att([3], 0, epoch, root=b"\x0a" * 32)
+    a2 = _att([3], 0, epoch, root=b"\x0b" * 32)
+    svc.observe_indexed_attestation(a1)
+    svc.observe_indexed_attestation(a2)
+    stats = svc.on_slot(h.chain.head_state.slot + E.SLOTS_PER_EPOCH)
+    assert stats is not None
+    assert h.chain.op_pool._attester_slashings, "slashing not pooled"
+    # the produced block carries it
+    slot = h.chain.head_state.slot + 1
+    h.slot_clock.set_slot(slot)
+    block, _ = h.chain.produce_block_on_state(slot, h.randao_reveal(0, slot))
+    assert len(block.body.attester_slashings) == 1
+    slashed = set(
+        block.body.attester_slashings[0].attestation_1.attesting_indices
+    ) & set(block.body.attester_slashings[0].attestation_2.attesting_indices)
+    assert slashed == {3}
