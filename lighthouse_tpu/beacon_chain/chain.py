@@ -39,6 +39,12 @@ from .attestation_verification import (
 from .op_pool import OperationPool
 
 
+class BlobsUnavailableError(ValueError):
+    """Raised when a commitment-carrying block awaits its sidecars — an
+    expected ordering race, distinct from genuine invalidity (gossip
+    handlers must not penalize the forwarder for it)."""
+
+
 class BlockError(ValueError):
     pass
 
@@ -518,7 +524,7 @@ class BeaconChain:
             except AvailabilityCheckError as e:
                 raise BlockError(f"data availability: {e}") from e
             if not avail.available:
-                raise BlockError(
+                raise BlobsUnavailableError(
                     "blobs unavailable: feed sidecars via process_blob_sidecars"
                 )
             imported_blobs = avail.blobs
@@ -567,8 +573,14 @@ class BeaconChain:
         for att in block.body.attestations:
             try:
                 indexed = ctxt.get_indexed_attestation(state, att, self.E)
-                if self.slasher_service is not None:
+            except Exception:
+                continue  # unindexable in this context
+            if self.slasher_service is not None:
+                try:
                     self.slasher_service.observe_indexed_attestation(indexed)
+                except Exception:  # noqa: BLE001 — slasher faults must not
+                    pass  # cost fork choice its attestation weight
+            try:
                 self.fork_choice.on_attestation(indexed, is_from_block=True)
             except Exception:
                 continue  # fork-choice-irrelevant attestations are skipped
@@ -851,26 +863,34 @@ class BeaconChain:
             self.sync_message_pool.prune(self.slot_clock.now())
         return positions
 
-    def process_blob_sidecars(self, block_root: bytes, sidecars: list):
+    def process_blob_sidecars(
+        self, block_root: bytes, sidecars: list, verify_header_signature=True
+    ):
         """KZG-verify and stage blob sidecars for a block (gossip/RPC blobs
-        path → data_availability_checker.put_blobs). The sidecar header's
-        proposer signature is verified first — without it anyone could
-        flood the pending dict with self-consistent KZG data under
-        fabricated headers (gossip condition: valid header signature)."""
+        path → data_availability_checker.put_blobs). On the gossip path
+        the sidecar header's proposer signature is verified first —
+        without it anyone could flood the pending dict with
+        self-consistent KZG data under fabricated headers. Sync passes
+        verify_header_signature=False: its blocks may be ahead of our
+        head (unknown proposers / later forks) and the segment batch
+        verifies the block signatures itself."""
         from .data_availability import AvailabilityCheckError
 
-        for sc in sidecars:
-            header = getattr(sc, "signed_block_header", None)
-            if header is None:
-                continue
-            try:
-                ok = sigsets.block_header_signature_set(
-                    self.head_state, header, self.spec, self.E
-                ).verify()
-            except (IndexError, KeyError, ValueError) as e:
-                raise BlockError(f"blob sidecar header malformed: {e}") from e
-            if not ok:
-                raise BlockError("blob sidecar header signature invalid")
+        if verify_header_signature:
+            for sc in sidecars:
+                header = getattr(sc, "signed_block_header", None)
+                if header is None:
+                    continue
+                try:
+                    ok = sigsets.block_header_signature_set(
+                        self.head_state, header, self.spec, self.E
+                    ).verify()
+                except (IndexError, KeyError, ValueError) as e:
+                    raise BlockError(
+                        f"blob sidecar header malformed: {e}"
+                    ) from e
+                if not ok:
+                    raise BlockError("blob sidecar header signature invalid")
         try:
             return self.data_availability_checker.put_blobs(
                 block_root, sidecars, slot=self.slot_clock.now()

@@ -55,8 +55,15 @@ class DataAvailabilityChecker:
         pend = self._pending.get(block_root)
         if pend is None:
             if len(self._pending) >= self.MAX_PENDING:
+                # evict blob-only entries first: an entry holding a staged
+                # BLOCK is one sidecar away from import and gossip dedup
+                # means nobody will re-send that block
+                blockless = [
+                    r for r, p in self._pending.items() if p.block is None
+                ]
+                pool = blockless or list(self._pending)
                 oldest = min(
-                    self._pending, key=lambda r: self._pending[r].inserted_at_slot
+                    pool, key=lambda r: self._pending[r].inserted_at_slot
                 )
                 self._pending.pop(oldest)
             pend = PendingComponents()

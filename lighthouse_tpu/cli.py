@@ -221,6 +221,31 @@ def cmd_db(args):
             found = int.from_bytes(raw, "little") if raw else None
             if found == CURRENT_SCHEMA_VERSION:
                 print("already at current schema")
+            elif found == 1:
+                # v1→v2: prepend the slot prefix to BLOB_SIDECARS values
+                # (slot read from the first sidecar's header)
+                from .types.containers import build_types
+
+                _spec, E_ = _load_spec(args.spec)
+                t = build_types(E_)
+                migrated = 0
+                for root in store.keys(DBColumn.BLOB_SIDECARS):
+                    data = store.get(DBColumn.BLOB_SIDECARS, root)
+                    n = int.from_bytes(data[:4], "little")
+                    sc = t.BlobSidecar.deserialize(data[4 : 4 + n])
+                    slot = int(sc.signed_block_header.message.slot)
+                    store.put(
+                        DBColumn.BLOB_SIDECARS,
+                        root,
+                        slot.to_bytes(8, "little") + data,
+                    )
+                    migrated += 1
+                store.put(
+                    DBColumn.BEACON_META,
+                    SCHEMA_VERSION_KEY,
+                    CURRENT_SCHEMA_VERSION.to_bytes(8, "little"),
+                )
+                print(f"migrated v1 -> v2 ({migrated} blob entries)")
             else:
                 raise SystemExit(
                     f"no migration path from v{found} — re-sync required"

@@ -344,7 +344,9 @@ class SyncManager:
             by_root.setdefault(r, []).append(sc)
         for root, scs in by_root.items():
             try:
-                chain.process_blob_sidecars(root, scs)
+                chain.process_blob_sidecars(
+                    root, scs, verify_header_signature=False
+                )
             except Exception:  # noqa: BLE001 — bad sidecar: penalize, move on
                 self.service.peers.report(peer.peer_id, SCORE_INVALID_MESSAGE)
                 # the affected block then fails its DA gate in the segment
@@ -417,7 +419,14 @@ class NetworkService:
 
         digest = self.fork_digest()
         self.topic_block = M.gossip_topic(digest, M.TOPIC_BEACON_BLOCK)
-        self.topic_att = M.gossip_topic(digest, M.TOPIC_BEACON_ATTESTATION)
+        # one topic per attestation subnet; a full node stays subscribed
+        # to all of them (the flood model relays everything), while the
+        # SubnetService tracks duty subnets for ENR advertisement
+        self.attestation_topics = {
+            i: M.gossip_topic(digest, M.attestation_subnet_topic_name(i))
+            for i in range(M.ATTESTATION_SUBNET_COUNT)
+        }
+        self.topic_att = self.attestation_topics[0]
         self.topic_aggregate = M.gossip_topic(digest, M.TOPIC_AGGREGATE)
         self.topic_exit = M.gossip_topic(digest, M.TOPIC_VOLUNTARY_EXIT)
         self.topic_proposer_slashing = M.gossip_topic(
@@ -431,7 +440,8 @@ class NetworkService:
         )
         self.topic_blob_sidecar = M.gossip_topic(digest, M.TOPIC_BLOB_SIDECAR)
         self.gossip.subscribe(self.topic_block, self._on_gossip_block)
-        self.gossip.subscribe(self.topic_att, self._on_gossip_attestation)
+        for topic in self.attestation_topics.values():
+            self.gossip.subscribe(topic, self._on_gossip_attestation)
         self.gossip.subscribe(self.topic_aggregate, self._on_gossip_aggregate)
         self.gossip.subscribe(self.topic_exit, self._on_gossip_exit)
         self.gossip.subscribe(
@@ -614,19 +624,16 @@ class NetworkService:
 
     def _on_gossip_block(self, data: bytes):
         signed = self.decode_block(data)
+        from ..beacon_chain.chain import BlobsUnavailableError
+
         try:
             self.chain.process_block(signed)
-        except Exception as e:  # noqa: BLE001
-            if "blobs unavailable" in str(e):
-                # expected ordering race, not peer fault: the block is
-                # staged in the DA checker; the completing sidecar's
-                # handler imports it (no downscore for the forwarder)
-                log.info(
-                    "block waiting on sidecars",
-                    slot=signed.message.slot,
-                )
-                return
-            raise
+        except BlobsUnavailableError:
+            # expected ordering race, not peer fault: the block is staged
+            # in the DA checker; the completing sidecar's handler imports
+            # it (no downscore for the forwarder)
+            log.info("block waiting on sidecars", slot=signed.message.slot)
+            return
         log.info(
             "gossip block imported",
             slot=signed.message.slot,
@@ -688,9 +695,24 @@ class NetworkService:
         self.gossip.publish(self.topic_block, signed_block.serialize())
 
     def publish_attestation(self, attestation):
+        """Publish on the attestation's own subnet topic
+        (compute_subnet_for_attestation over the committee layout)."""
         t = self.chain.types
+        data = attestation.data
+        try:
+            from ..state_processing.accessors import committee_cache_at
+
+            cc = committee_cache_at(
+                self.chain.head_state, data.target.epoch, self.chain.E
+            )
+            subnet = M.compute_subnet_for_attestation(
+                cc.committees_per_slot, data.slot, data.index, self.chain.E
+            )
+        except Exception:  # noqa: BLE001 — unknown epoch: default subnet
+            subnet = 0
         self.gossip.publish(
-            self.topic_att, t.Attestation.serialize_value(attestation)
+            self.attestation_topics[subnet],
+            t.Attestation.serialize_value(attestation),
         )
 
     def publish_aggregate(self, signed_aggregate):

@@ -96,7 +96,23 @@ PROTO_BLOBS_BY_ROOT = "/eth2/beacon_chain/req/blob_sidecars_by_root/1/ssz_snappy
 PROTO_GOSSIP = "/lighthouse_tpu/gossip/1"  # persistent pub/sub stream
 
 TOPIC_BEACON_BLOCK = "beacon_block"
-TOPIC_BEACON_ATTESTATION = "beacon_attestation_0"
+ATTESTATION_SUBNET_COUNT = 64
+TOPIC_BEACON_ATTESTATION = "beacon_attestation_0"  # subnet-0 (back compat)
+
+
+def attestation_subnet_topic_name(subnet_id: int) -> str:
+    return f"beacon_attestation_{int(subnet_id)}"
+
+
+def compute_subnet_for_attestation(
+    committees_per_slot: int, slot: int, committee_index: int, E
+) -> int:
+    """validator.md compute_subnet_for_attestation."""
+    slots_since_epoch_start = int(slot) % E.SLOTS_PER_EPOCH
+    committees_since_epoch_start = committees_per_slot * slots_since_epoch_start
+    return (
+        committees_since_epoch_start + int(committee_index)
+    ) % ATTESTATION_SUBNET_COUNT
 TOPIC_AGGREGATE = "beacon_aggregate_and_proof"
 TOPIC_VOLUNTARY_EXIT = "voluntary_exit"
 TOPIC_PROPOSER_SLASHING = "proposer_slashing"

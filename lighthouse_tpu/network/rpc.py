@@ -191,7 +191,8 @@ class RpcServer:
             # blob responses are ~128KiB each — the spec bounds this
             # protocol by sidecar count (MAX_REQUEST_BLOB_SIDECARS), not
             # block count
-            if req.count * 6 > MAX_REQUEST_BLOB_SIDECARS:
+            max_blobs = node.chain.E.MAX_BLOBS_PER_BLOCK
+            if req.count * max_blobs > MAX_REQUEST_BLOB_SIDECARS:
                 self._respond(sock, RESP_INVALID_REQUEST, b"")
                 return
             for sc in node.blob_sidecars_by_range(req.start_slot, req.count):

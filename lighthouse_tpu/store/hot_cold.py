@@ -22,7 +22,8 @@ SCHEMA_VERSION_KEY = b"schema"
 # On-disk schema version (store/src/lib.rs CURRENT_SCHEMA_VERSION analog).
 # Bump on any layout change; `open` detects mismatches so a migration (or a
 # refusal to run) happens instead of silent misreads.
-CURRENT_SCHEMA_VERSION = 1
+# v2: BLOB_SIDECARS values gained an 8-byte slot prefix
+CURRENT_SCHEMA_VERSION = 2
 
 # Stable 1-byte fork tags prefixed to stored states/blocks so decode picks
 # the right SSZ variant (the reference keys this off slot + spec; an explicit
@@ -141,9 +142,9 @@ class HotColdDB:
         8-byte prefix, no SSZ decode."""
         out = []
         for root in self.hot.keys(DBColumn.BLOB_SIDECARS):
-            data = self.hot.get(DBColumn.BLOB_SIDECARS, root)
-            if data and len(data) >= 8:
-                out.append((root, int.from_bytes(data[:8], "little")))
+            prefix = self.hot.get_prefix(DBColumn.BLOB_SIDECARS, root, 8)
+            if prefix and len(prefix) == 8:
+                out.append((root, int.from_bytes(prefix, "little")))
         return out
 
     def get_blob_sidecars(self, block_root: bytes) -> list:

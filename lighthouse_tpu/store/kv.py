@@ -43,6 +43,12 @@ class ItemStore:
     def exists(self, column: DBColumn, key: bytes) -> bool:
         return self.get(column, key) is not None
 
+    def get_prefix(self, column: DBColumn, key: bytes, n: int) -> bytes | None:
+        """First `n` bytes of a value. Default reads the whole value;
+        backends with partial reads (sqlite substr) override."""
+        v = self.get(column, key)
+        return None if v is None else v[:n]
+
     def keys(self, column: DBColumn):
         raise NotImplementedError
 
@@ -134,6 +140,16 @@ class SqliteStore(ItemStore):
     def keys(self, column):
         cur = self._conn.execute(f"SELECT k FROM c_{column.value}")
         return [row[0] for row in cur.fetchall()]
+
+    def get_prefix(self, column, key, n):
+        # substr keeps multi-hundred-KiB blob values out of the page
+        # cache when only the slot prefix is wanted
+        cur = self._conn.execute(
+            f"SELECT substr(v, 1, ?) FROM c_{column.value} WHERE k = ?",
+            (n, key),
+        )
+        row = cur.fetchone()
+        return row[0] if row else None
 
     def do_atomically(self, ops):
         with self._lock:

@@ -97,3 +97,39 @@ def test_boot_node_cmd_serves_discovery(capsys):
     finally:
         d.stop()
         t.join(timeout=5)
+
+
+def test_db_migrate_v1_blob_prefix(tmp_path, capsys):
+    """v1→v2 migration prepends the slot prefix to BLOB_SIDECARS values."""
+    from lighthouse_tpu.store.hot_cold import SCHEMA_VERSION_KEY
+    from lighthouse_tpu.store.kv import DBColumn, SqliteStore
+    from lighthouse_tpu.types.containers import build_types
+
+    t = build_types(E)
+    sc = t.BlobSidecar()
+    hdr = sc.signed_block_header.message.copy()
+    hdr.slot = 77
+    sc.signed_block_header = t.SignedBeaconBlockHeader(
+        message=hdr, signature=b"\x00" * 96
+    )
+    data = sc.serialize()
+    v1_value = len(data).to_bytes(4, "little") + data  # no slot prefix
+
+    path = str(tmp_path / "v1.db")
+    store = SqliteStore(path)
+    store.put(DBColumn.BEACON_META, SCHEMA_VERSION_KEY, (1).to_bytes(8, "little"))
+    store.put(DBColumn.BLOB_SIDECARS, b"\x0c" * 32, v1_value)
+    store.close()
+
+    assert main(["--spec", "minimal", "db", "migrate", path]) == 0
+    assert "migrated v1 -> v2 (1 blob entries)" in capsys.readouterr().out
+
+    store = SqliteStore(path)
+    raw = store.get(DBColumn.BLOB_SIDECARS, b"\x0c" * 32)
+    assert int.from_bytes(raw[:8], "little") == 77
+    assert raw[8:] == v1_value
+    assert (
+        int.from_bytes(store.get(DBColumn.BEACON_META, SCHEMA_VERSION_KEY), "little")
+        == 2
+    )
+    store.close()

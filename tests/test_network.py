@@ -186,6 +186,52 @@ def test_gossip_operation_topics_feed_pools(two_nodes):
     assert not a.chain.op_pool._voluntary_exits
 
 
+def test_attestation_subnet_routing(two_nodes):
+    """Attestations ride their computed subnet topic (validator.md
+    compute_subnet_for_attestation) and still reach peers — who subscribe
+    to every subnet — and the subnet service advertises duty subnets in
+    the discovery record."""
+    from lighthouse_tpu.network import messages as M
+    from lighthouse_tpu.network.discovery import DiscoveryService
+    from lighthouse_tpu.network.subnet_service import AttestationSubnetService
+    from lighthouse_tpu.validator_client import ValidatorClient
+
+    a, na, b, nb = two_nodes
+    b.slot_clock.set_slot(a.chain.head_state.slot)
+    nb.connect("127.0.0.1", na.port)
+    nb.sync.sync_with(nb.peers.peers()[0])
+    time.sleep(0.2)
+    slot = b.chain.head_state.slot + 1
+    b.slot_clock.set_slot(slot)
+    a.slot_clock.set_slot(slot)
+    atts = b.make_unaggregated_attestations(slot, b.chain.head_root)
+    before = a.chain.op_pool.num_attestations()
+    for att in atts[:4]:
+        nb.publish_attestation(att)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if a.chain.op_pool.num_attestations() > before:
+            break
+        time.sleep(0.05)
+    assert a.chain.op_pool.num_attestations() > before
+
+    # subnet computation is deterministic and in range
+    subnet = M.compute_subnet_for_attestation(4, slot, 2, E)
+    assert 0 <= subnet < M.ATTESTATION_SUBNET_COUNT
+
+    # duty subnets advertised via discovery attnets
+    na.discovery = DiscoveryService(tcp_port=na.port)
+    svc = AttestationSubnetService(na, node_id_seed=7)
+    vc = ValidatorClient(a.chain, a.keypairs, a.spec, E)
+    epoch = a.chain.head_state.slot // E.SLOTS_PER_EPOCH
+    duties = vc.duties_service.attester_duties(epoch)
+    subnets = svc.register_duties(duties, epoch)
+    assert subnets  # 16 validators → at least one duty subnet
+    assert set(svc.persistent_subnets) <= set(svc.active_subnets())
+    assert na.discovery.local_enr.subnets == svc.active_subnets()
+    na.discovery.stop()
+
+
 def test_fork_digest_mismatch_rejected():
     a = _harness()
     spec2 = replace(minimal_spec(), altair_fork_epoch=0, altair_fork_version=b"\x09\x00\x00\x09")
