@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -254,6 +255,12 @@ class HttpEngineClient(ExecutionLayer):
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 doc = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # an HTTP error response (401 auth, 5xx) came from a LIVE
+            # engine — application-level, must not flip the watchdog
+            raise ExecutionLayerError(
+                f"{method}: HTTP {e.code}: {e.read()[:200]!r}"
+            ) from e
         except OSError as e:
             # transport distinct from application errors: only this kind
             # should flip the watchdog to OFFLINE

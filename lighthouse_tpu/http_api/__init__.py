@@ -370,6 +370,221 @@ class BeaconApi:
 
     # -- validator -----------------------------------------------------------
 
+    # -- config routes ---------------------------------------------------
+
+    def config_spec(self):
+        """GET /eth/v1/config/spec: the runtime ChainSpec as the API's
+        flat name/value map (config_and_preset.rs)."""
+        import dataclasses
+
+        spec = self.chain.spec
+        out = {}
+        for f in dataclasses.fields(spec):
+            v = getattr(spec, f.name)
+            key = f.name.upper()
+            if isinstance(v, bytes):
+                out[key] = _hex(v)
+            elif isinstance(v, int):
+                out[key] = str(v)
+            elif v is not None:
+                out[key] = str(v)
+        return {"data": out}
+
+    def config_deposit_contract(self):
+        return {
+            "data": {
+                "chain_id": str(getattr(self.chain.spec, "deposit_chain_id", 1)),
+                "address": _hex(self.chain.spec.deposit_contract_address),
+            }
+        }
+
+    def config_fork_schedule(self):
+        spec = self.chain.spec
+        E = self.chain.E
+        sched = []
+        prev = spec.genesis_fork_version
+        for name, ver_attr, epoch_attr in (
+            ("phase0", "genesis_fork_version", None),
+            ("altair", "altair_fork_version", "altair_fork_epoch"),
+            ("bellatrix", "bellatrix_fork_version", "bellatrix_fork_epoch"),
+            ("capella", "capella_fork_version", "capella_fork_epoch"),
+            ("deneb", "deneb_fork_version", "deneb_fork_epoch"),
+            ("electra", "electra_fork_version", "electra_fork_epoch"),
+        ):
+            ver = getattr(spec, ver_attr, None)
+            epoch = 0 if epoch_attr is None else getattr(spec, epoch_attr, None)
+            if ver is None or epoch is None:
+                continue
+            sched.append(
+                {
+                    "previous_version": _hex(prev),
+                    "current_version": _hex(ver),
+                    "epoch": str(epoch),
+                }
+            )
+            prev = ver
+        return {"data": sched}
+
+    # -- committees / duties ---------------------------------------------
+
+    def state_committees(self, state_id: str, epoch=None):
+        """GET /eth/v1/beacon/states/{id}/committees."""
+        from ..state_processing.accessors import committee_cache_at
+
+        st = self._state(state_id)
+        if epoch is None:
+            epoch = compute_epoch_at_slot(st.slot, self.chain.E)
+        epoch = int(epoch)
+        cc = committee_cache_at(st, epoch, self.chain.E)
+        start = compute_start_slot_at_epoch(epoch, self.chain.E)
+        out = []
+        for slot in range(start, start + self.chain.E.SLOTS_PER_EPOCH):
+            for index in range(cc.committees_per_slot):
+                out.append(
+                    {
+                        "index": str(index),
+                        "slot": str(slot),
+                        "validators": [
+                            str(v) for v in cc.committee(slot, index)
+                        ],
+                    }
+                )
+        return {"data": out}
+
+    def attester_duties(self, epoch: int, indices: list[int]):
+        """POST /eth/v1/validator/duties/attester/{epoch}."""
+        from ..state_processing.accessors import committee_cache_at
+
+        chain = self.chain
+        st = chain.head_state
+        wanted = {int(i) for i in indices}
+        cc = committee_cache_at(st, int(epoch), chain.E)
+        start = compute_start_slot_at_epoch(int(epoch), chain.E)
+        duties = []
+        for slot in range(start, start + chain.E.SLOTS_PER_EPOCH):
+            for index in range(cc.committees_per_slot):
+                committee = cc.committee(slot, index)
+                for pos, vi in enumerate(committee):
+                    if vi in wanted:
+                        duties.append(
+                            {
+                                "pubkey": _hex(st.validators[vi].pubkey),
+                                "validator_index": str(vi),
+                                "committee_index": str(index),
+                                "committee_length": str(len(committee)),
+                                "committees_at_slot": str(cc.committees_per_slot),
+                                "validator_committee_index": str(pos),
+                                "slot": str(slot),
+                            }
+                        )
+        return {"data": duties, "dependent_root": _hex(chain.head_root)}
+
+    def sync_duties(self, epoch: int, indices: list[int]):
+        """POST /eth/v1/validator/duties/sync/{epoch}: valid for the
+        current and next sync-committee periods — an epoch past the
+        period boundary answers from next_sync_committee (VCs pre-fetch
+        next-period duties before rotation)."""
+        st = self.chain.head_state
+        E = self.chain.E
+        period_epochs = E.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        current_period = compute_epoch_at_slot(st.slot, E) // period_epochs
+        wanted_period = int(epoch) // period_epochs
+        if wanted_period == current_period:
+            committee = getattr(st, "current_sync_committee", None)
+        elif wanted_period == current_period + 1:
+            committee = getattr(st, "next_sync_committee", None)
+        else:
+            raise ApiError(
+                400, f"epoch {epoch} outside the current/next sync periods"
+            )
+        if committee is None:
+            return {"data": []}
+        wanted = {int(i) for i in indices}
+        by_pubkey: dict[bytes, list[int]] = {}
+        for pos, pk in enumerate(committee.pubkeys):
+            by_pubkey.setdefault(bytes(pk), []).append(pos)
+        duties = []
+        for vi in sorted(wanted):
+            if vi >= len(st.validators):
+                continue
+            pk = bytes(st.validators[vi].pubkey)
+            positions = by_pubkey.get(pk)
+            if positions:
+                duties.append(
+                    {
+                        "pubkey": _hex(pk),
+                        "validator_index": str(vi),
+                        "validator_sync_committee_indices": [
+                            str(p) for p in positions
+                        ],
+                    }
+                )
+        return {"data": duties}
+
+    # -- pools / blobs ---------------------------------------------------
+
+    def pool_attestations(self):
+        pool = self.chain.op_pool
+        out = []
+
+        def cp(c):
+            return {"epoch": str(c.epoch), "root": _hex(c.root)}
+
+        for bucket in pool._attestations.values():
+            for att in bucket.values():
+                bits_t = type(att)._fields["aggregation_bits"]
+                out.append(
+                    {
+                        # the SSZ Bitlist codec (delimiter bit included) —
+                        # never a hand-rolled bit pack
+                        "aggregation_bits": _hex(
+                            bits_t.serialize_value(att.aggregation_bits)
+                        ),
+                        "data": {
+                            "slot": str(att.data.slot),
+                            "index": str(att.data.index),
+                            "beacon_block_root": _hex(att.data.beacon_block_root),
+                            "source": cp(att.data.source),
+                            "target": cp(att.data.target),
+                        },
+                        "signature": _hex(att.signature),
+                    }
+                )
+        return {"data": out}
+
+    def pool_voluntary_exits(self):
+        return {
+            "data": [
+                {
+                    "message": {
+                        "epoch": str(ex.message.epoch),
+                        "validator_index": str(ex.message.validator_index),
+                    },
+                    "signature": _hex(ex.signature),
+                }
+                for ex in self.chain.op_pool._voluntary_exits.values()
+            ]
+        }
+
+    def blob_sidecars(self, block_id: str):
+        """GET /eth/v1/beacon/blob_sidecars/{block_id} (SSZ list body)."""
+        root, _signed = self._block(block_id)
+        sidecars = self.chain.store.get_blob_sidecars(root)
+        t = self.chain.types
+        from ..ssz.core import List as SszList
+
+        limit = self.chain.E.MAX_BLOB_COMMITMENTS_PER_BLOCK
+        return SszList[t.BlobSidecar, limit].serialize_value(sidecars)
+
+    def publish_voluntary_exit_ssz(self, data: bytes) -> int:
+        t = self.chain.types
+        exit_ = t.SignedVoluntaryExit.deserialize(data)
+        try:
+            self.chain.process_voluntary_exit(exit_)
+        except Exception as e:  # noqa: BLE001
+            raise ApiError(400, f"exit rejected: {e}") from e
+        return 200
+
     def proposer_duties(self, epoch: int):
         from ..state_processing import per_slot_processing
 
@@ -422,6 +637,11 @@ _ROUTES = [
     ("GET", r"^/eth/v1/beacon/headers/(?P<block_id>[^/]+)$", "block_header"),
     ("GET", r"^/eth/v1/beacon/blocks/(?P<block_id>[^/]+)/root$", "block_root"),
     ("GET", r"^/eth/v1/validator/duties/proposer/(?P<epoch>\d+)$", "proposer_duties"),
+    ("GET", r"^/eth/v1/config/spec$", "config_spec"),
+    ("GET", r"^/eth/v1/config/deposit_contract$", "config_deposit_contract"),
+    ("GET", r"^/eth/v1/config/fork_schedule$", "config_fork_schedule"),
+    ("GET", r"^/eth/v1/beacon/pool/attestations$", "pool_attestations"),
+    ("GET", r"^/eth/v1/beacon/pool/voluntary_exits$", "pool_voluntary_exits"),
 ]
 
 
@@ -476,6 +696,22 @@ class _Handler(BaseHTTPRequestHandler):
             m = re.match(r"^/eth/v2/debug/beacon/states/(?P<state_id>[^/]+)$", path)
             if m:
                 self._send_bytes(self.api.debug_state_ssz(m.group("state_id")))
+                return
+            m = re.match(
+                r"^/eth/v1/beacon/states/(?P<state_id>[^/]+)/committees$", path
+            )
+            if m:
+                q = parse_qs(parsed.query)
+                epoch = q.get("epoch", [None])[0]
+                self._send_json(
+                    self.api.state_committees(m.group("state_id"), epoch)
+                )
+                return
+            m = re.match(
+                r"^/eth/v1/beacon/blob_sidecars/(?P<block_id>[^/]+)$", path
+            )
+            if m:
+                self._send_bytes(self.api.blob_sidecars(m.group("block_id")))
                 return
             m = re.match(
                 r"^/eth/v1/beacon/light_client/bootstrap/(?P<root>0x[0-9a-fA-F]+)$",
@@ -598,6 +834,23 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/eth/v1/validator/prepare_beacon_proposer":
                 code = self.api.prepare_beacon_proposer(json.loads(body))
                 self._send_json({"code": code, "message": "ok"}, code)
+                return
+            if path == "/eth/v1/beacon/pool/voluntary_exits":
+                code = self.api.publish_voluntary_exit_ssz(body)
+                self._send_json({"code": code, "message": "ok"}, code)
+                return
+            m = re.match(
+                r"^/eth/v1/validator/duties/(?P<kind>attester|sync)/(?P<epoch>\d+)$",
+                path,
+            )
+            if m:
+                indices = [int(i) for i in json.loads(body)]
+                fn = (
+                    self.api.attester_duties
+                    if m.group("kind") == "attester"
+                    else self.api.sync_duties
+                )
+                self._send_json(fn(int(m.group("epoch")), indices))
                 return
             raise ApiError(404, f"unknown route {path}")
         except ApiError as e:

@@ -132,3 +132,63 @@ def test_metrics_endpoint(rig):
     status, body = _get(server, "/metrics")
     assert status == 200
     assert b"beacon_blocks_imported_total" in body
+
+
+def _post_json(server, path, obj):
+    body = json.dumps(obj).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_config_routes(rig):
+    h, server = rig
+    spec_doc = _get(server, "/eth/v1/config/spec")[1]["data"]
+    assert spec_doc["SECONDS_PER_SLOT"] == str(h.spec.seconds_per_slot)
+    dc = _get(server, "/eth/v1/config/deposit_contract")[1]["data"]
+    assert dc["address"].startswith("0x") and len(dc["address"]) == 42
+    sched = _get(server, "/eth/v1/config/fork_schedule")[1]["data"]
+    assert sched[0]["epoch"] == "0"
+    assert any(f["current_version"] != sched[0]["current_version"] for f in sched)
+
+
+def test_committees_and_duty_routes(rig):
+    h, server = rig
+    epoch = h.chain.head_state.slot // E.SLOTS_PER_EPOCH
+    comm = _get(
+        server, f"/eth/v1/beacon/states/head/committees?epoch={epoch}"
+    )[1]["data"]
+    assert len(comm) >= E.SLOTS_PER_EPOCH  # >=1 committee per slot
+    all_vals = sorted(int(v) for c in comm for v in c["validators"])
+    assert all_vals == list(range(16))  # every validator seated once
+
+    duties = _post_json(
+        server, f"/eth/v1/validator/duties/attester/{epoch}", ["0", "5"]
+    )["data"]
+    assert sorted(int(d["validator_index"]) for d in duties) == [0, 5]
+    d0 = duties[0]
+    assert int(d0["committee_length"]) >= 1 and "slot" in d0
+
+    sync = _post_json(
+        server, f"/eth/v1/validator/duties/sync/{epoch}", list(range(16))
+    )["data"]
+    # altair-at-genesis: every committee position maps to our validators
+    positions = [p for d in sync for p in d["validator_sync_committee_indices"]]
+    assert len(positions) == E.SYNC_COMMITTEE_SIZE
+
+
+def test_pool_and_blob_routes(rig):
+    h, server = rig
+    slot = h.chain.head_state.slot
+    h.attest_to_head(slot)
+    pool = _get(server, "/eth/v1/beacon/pool/attestations")[1]["data"]
+    assert pool and pool[0]["signature"].startswith("0x")
+    _code, exits = _get(server, "/eth/v1/beacon/pool/voluntary_exits")
+    assert exits["data"] == []
+    # blob route: empty SSZ list for a blobless block
+    code, raw = _get(server, "/eth/v1/beacon/blob_sidecars/head")
+    assert code == 200 and raw == b""
