@@ -434,8 +434,11 @@ class BeaconApi:
         st = self._state(state_id)
         if epoch is None:
             epoch = compute_epoch_at_slot(st.slot, self.chain.E)
-        epoch = int(epoch)
-        cc = committee_cache_at(st, epoch, self.chain.E)
+        try:
+            epoch = int(epoch)
+            cc = committee_cache_at(st, epoch, self.chain.E)
+        except ValueError as e:
+            raise ApiError(400, f"bad epoch: {e}") from e
         start = compute_start_slot_at_epoch(epoch, self.chain.E)
         out = []
         for slot in range(start, start + self.chain.E.SLOTS_PER_EPOCH):
@@ -458,7 +461,10 @@ class BeaconApi:
         chain = self.chain
         st = chain.head_state
         wanted = {int(i) for i in indices}
-        cc = committee_cache_at(st, int(epoch), chain.E)
+        try:
+            cc = committee_cache_at(st, int(epoch), chain.E)
+        except ValueError as e:
+            raise ApiError(400, f"epoch out of range: {e}") from e
         start = compute_start_slot_at_epoch(int(epoch), chain.E)
         duties = []
         for slot in range(start, start + chain.E.SLOTS_PER_EPOCH):
@@ -477,7 +483,24 @@ class BeaconApi:
                                 "slot": str(slot),
                             }
                         )
-        return {"data": duties, "dependent_root": _hex(chain.head_root)}
+        return {
+            "data": duties,
+            "dependent_root": _hex(self._dependent_root(st, int(epoch))),
+        }
+
+    def _dependent_root(self, st, epoch: int) -> bytes:
+        """Beacon API dependent_root: the block root at the last slot of
+        epoch-1 — stable within the epoch, so VCs only re-fetch duties on
+        a genuine reorg of that slot (NOT the ever-moving head root)."""
+        from ..state_processing.accessors import get_block_root_at_slot
+
+        start = compute_start_slot_at_epoch(epoch, self.chain.E)
+        if start == 0:
+            return bytes(self.chain.genesis_block_root)
+        try:
+            return get_block_root_at_slot(st, start - 1, self.chain.E)
+        except Exception:  # noqa: BLE001 — slot outside the roots window
+            return bytes(self.chain.head_root)
 
     def sync_duties(self, epoch: int, indices: list[int]):
         """POST /eth/v1/validator/duties/sync/{epoch}: valid for the
@@ -530,8 +553,9 @@ class BeaconApi:
         def cp(c):
             return {"epoch": str(c.epoch), "root": _hex(c.root)}
 
-        for bucket in pool._attestations.values():
-            for att in bucket.values():
+        # snapshot: gossip/VC threads mutate the pool during this walk
+        for bucket in list(pool._attestations.values()):
+            for att in list(bucket.values()):
                 bits_t = type(att)._fields["aggregation_bits"]
                 out.append(
                     {
@@ -562,12 +586,27 @@ class BeaconApi:
                     },
                     "signature": _hex(ex.signature),
                 }
-                for ex in self.chain.op_pool._voluntary_exits.values()
+                for ex in list(self.chain.op_pool._voluntary_exits.values())
             ]
         }
 
     def blob_sidecars(self, block_id: str):
-        """GET /eth/v1/beacon/blob_sidecars/{block_id} (SSZ list body)."""
+        """GET /eth/v1/beacon/blob_sidecars/{block_id} — JSON shape."""
+        root, _signed = self._block(block_id)
+        return {
+            "data": [
+                {
+                    "index": str(sc.index),
+                    "blob": _hex(sc.blob),
+                    "kzg_commitment": _hex(sc.kzg_commitment),
+                    "kzg_proof": _hex(sc.kzg_proof),
+                }
+                for sc in self.chain.store.get_blob_sidecars(root)
+            ]
+        }
+
+    def blob_sidecars_ssz(self, block_id: str) -> bytes:
+        """Same route under Accept: application/octet-stream."""
         root, _signed = self._block(block_id)
         sidecars = self.chain.store.get_blob_sidecars(root)
         t = self.chain.types
@@ -578,7 +617,10 @@ class BeaconApi:
 
     def publish_voluntary_exit_ssz(self, data: bytes) -> int:
         t = self.chain.types
-        exit_ = t.SignedVoluntaryExit.deserialize(data)
+        try:
+            exit_ = t.SignedVoluntaryExit.deserialize(data)
+        except Exception as e:  # noqa: BLE001
+            raise ApiError(400, f"malformed SignedVoluntaryExit SSZ: {e}") from e
         try:
             self.chain.process_voluntary_exit(exit_)
         except Exception as e:  # noqa: BLE001
@@ -711,7 +753,12 @@ class _Handler(BaseHTTPRequestHandler):
                 r"^/eth/v1/beacon/blob_sidecars/(?P<block_id>[^/]+)$", path
             )
             if m:
-                self._send_bytes(self.api.blob_sidecars(m.group("block_id")))
+                if "application/octet-stream" in self.headers.get("Accept", ""):
+                    self._send_bytes(
+                        self.api.blob_sidecars_ssz(m.group("block_id"))
+                    )
+                else:
+                    self._send_json(self.api.blob_sidecars(m.group("block_id")))
                 return
             m = re.match(
                 r"^/eth/v1/beacon/light_client/bootstrap/(?P<root>0x[0-9a-fA-F]+)$",
@@ -836,6 +883,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json({"code": code, "message": "ok"}, code)
                 return
             if path == "/eth/v1/beacon/pool/voluntary_exits":
+                if "application/json" in self.headers.get("Content-Type", ""):
+                    raise ApiError(
+                        415, "JSON exit publishing not supported; use SSZ"
+                    )
                 code = self.api.publish_voluntary_exit_ssz(body)
                 self._send_json({"code": code, "message": "ok"}, code)
                 return

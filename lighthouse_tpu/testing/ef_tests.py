@@ -470,6 +470,70 @@ class ForkUpgradeHandler(Handler):
             raise CaseFailure(f"{case.path}: fork cases need post")
 
 
+class TransitionHandler(Handler):
+    """transition/core (cases/transition.rs): blocks cross a fork
+    boundary — the pre state and early blocks are the PREVIOUS fork's
+    types, the fork activates at meta.fork_epoch mid-run, late blocks are
+    the case fork's types."""
+
+    runner = "transition"
+    handler = "core"
+
+    PRE_FORK = {
+        ForkName.ALTAIR: ForkName.PHASE0,
+        ForkName.BELLATRIX: ForkName.ALTAIR,
+        ForkName.CAPELLA: ForkName.BELLATRIX,
+        ForkName.DENEB: ForkName.CAPELLA,
+        ForkName.ELECTRA: ForkName.DENEB,
+    }
+
+    def run(self, case: Case, ctx: Context):
+        import dataclasses
+
+        from ..state_processing import (
+            BlockSignatureStrategy,
+            per_block_processing,
+        )
+
+        meta = case.yaml("meta")
+        fork_epoch = int(meta["fork_epoch"])
+        count = int(meta["blocks_count"])
+        # fork_block: index of the last pre-fork block (None = all post)
+        fork_block = meta.get("fork_block")
+        post_fork = ctx.fork
+        pre_fork = self.PRE_FORK[post_fork]
+        pre_tf = ctx.types.types_for_fork(pre_fork)
+        post_tf = ctx.tf
+        spec = dataclasses.replace(
+            _spec_for(ctx.config, pre_fork),
+            **{f"{post_fork.name.lower()}_fork_epoch": fork_epoch},
+        )
+        state = pre_tf.BeaconState.deserialize(case.ssz_bytes("pre"))
+        blocks = []
+        for i in range(count):
+            tf = (
+                pre_tf
+                if fork_block is not None and i <= int(fork_block)
+                else post_tf
+            )
+            blocks.append(
+                tf.SignedBeaconBlock.deserialize(case.ssz_bytes(f"blocks_{i}"))
+            )
+        strategy = (
+            BlockSignatureStrategy.VERIFY_BULK
+            if _verify_sigs()
+            else BlockSignatureStrategy.NO_VERIFICATION
+        )
+
+        def mutate(st):
+            for signed in blocks:
+                while st.slot < signed.message.slot:
+                    per_slot_processing(st, spec, ctx.E)
+                per_block_processing(st, signed, spec, ctx.E, strategy=strategy)
+
+        _expect_post(case, ctx, state, mutate)
+
+
 # ---------------------------------------------------------------------------
 # The walker
 # ---------------------------------------------------------------------------
@@ -492,6 +556,8 @@ def _handler_for(runner: str, handler: str) -> Handler | None:
         return BlsHandler(handler)
     if runner == "fork":
         return ForkUpgradeHandler()
+    if runner == "transition" and handler == "core":
+        return TransitionHandler()
     return None
 
 

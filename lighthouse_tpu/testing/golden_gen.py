@@ -203,6 +203,42 @@ def generate_goldens(root: str | pathlib.Path, seed: int = 7) -> int:
     _write(case, "meta", {"fork": "altair"})
     count += 1
 
+    # --- transition/core (altair → bellatrix mid-run) ----------------------
+    from ..beacon_chain.harness import BeaconChainHarness
+
+    tspec = replace(
+        minimal_spec(), altair_fork_epoch=0, bellatrix_fork_epoch=1
+    )
+    th = BeaconChainHarness(tspec, E, validator_count=8)
+    t_pre = th.chain.head_state.copy()  # altair genesis
+    th.extend_chain(E.SLOTS_PER_EPOCH + 2, attest=False)
+    t_blocks = sorted(
+        th.chain._blocks_by_root.values(), key=lambda s: s.message.slot
+    )
+    case = (
+        root / "tests" / "minimal" / "bellatrix" / "transition" / "core"
+        / "pyspec_tests" / "altair_to_bellatrix"
+    )
+    _write(case, "pre", t_pre.serialize())
+    for i, signed in enumerate(t_blocks):
+        _write(case, f"blocks_{i}", signed.serialize())
+    _write(case, "post", th.chain.head_state.serialize())
+    # last pre-fork block: the final altair-epoch slot (fork at epoch 1)
+    fork_block = sum(
+        1 for s in t_blocks if s.message.slot < E.SLOTS_PER_EPOCH
+    ) - 1
+    _write(
+        case,
+        "meta",
+        {
+            "post_fork": "bellatrix",
+            "fork_epoch": 1,
+            "fork_block": fork_block,
+            "blocks_count": len(t_blocks),
+        },
+    )
+    count += 1
+
     # --- bls (real crypto; fork-agnostic: tests/general/phase0/bls) -------
     bls.set_backend("host")
     try:

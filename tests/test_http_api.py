@@ -190,5 +190,10 @@ def test_pool_and_blob_routes(rig):
     _code, exits = _get(server, "/eth/v1/beacon/pool/voluntary_exits")
     assert exits["data"] == []
     # blob route: empty SSZ list for a blobless block
-    code, raw = _get(server, "/eth/v1/beacon/blob_sidecars/head")
+    code, doc = _get(server, "/eth/v1/beacon/blob_sidecars/head")
+    assert code == 200 and doc["data"] == []
+    code, raw = _get(
+        server, "/eth/v1/beacon/blob_sidecars/head",
+        accept="application/octet-stream",
+    )
     assert code == 200 and raw == b""
