@@ -489,16 +489,17 @@ class BeaconApi:
         }
 
     def _dependent_root(self, st, epoch: int) -> bytes:
-        """Beacon API dependent_root: the block root at the last slot of
-        epoch-1 — stable within the epoch, so VCs only re-fetch duties on
-        a genuine reorg of that slot (NOT the ever-moving head root)."""
+        """Beacon API attester dependent_root: the block root at the last
+        slot BEFORE epoch-1 (where epoch's shuffling seed froze) — stable
+        across the epoch, so VCs only re-fetch duties on a genuine reorg
+        of that slot (NOT the ever-moving head root)."""
         from ..state_processing.accessors import get_block_root_at_slot
 
-        start = compute_start_slot_at_epoch(epoch, self.chain.E)
-        if start == 0:
+        if epoch < 2:
             return bytes(self.chain.genesis_block_root)
+        anchor = compute_start_slot_at_epoch(epoch - 1, self.chain.E) - 1
         try:
-            return get_block_root_at_slot(st, start - 1, self.chain.E)
+            return get_block_root_at_slot(st, anchor, self.chain.E)
         except Exception:  # noqa: BLE001 — slot outside the roots window
             return bytes(self.chain.head_root)
 

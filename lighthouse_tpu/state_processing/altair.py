@@ -627,6 +627,89 @@ def process_historical_summaries_update(state, E):
         )
 
 
+def _device_sweep_enabled() -> bool:
+    """LIGHTHOUSE_TPU_DEVICE_EPOCH_SWEEP=1 routes the fused rewards/
+    inactivity pass through the jitted device kernel (ops/epoch_sweep).
+    Importing that module enables JAX x64 process-wide, so the flag
+    belongs on dedicated node/bench processes (see the module docstring)."""
+    import os
+
+    return os.environ.get("LIGHTHOUSE_TPU_DEVICE_EPOCH_SWEEP") == "1"
+
+
+def _device_sweep_applicable(state, arrays: EpochArrays, spec, E) -> bool:
+    """The device kernel is u64-exact only while effective_balance·score
+    cannot overflow (the numpy path's bigint fallback has no device
+    equivalent) and at non-genesis epochs."""
+    from ..types.chain_spec import GENESIS_EPOCH
+
+    if get_current_epoch(state, E) == GENESIS_EPOCH:
+        return False
+    scores_max = max(state.inactivity_scores, default=0)
+    eb_max = int(arrays.effective_balance.max(initial=0))
+    # scores grow by at most the (spec-configurable) bias in this pass
+    margin = int(spec.inactivity_score_bias)
+    return not (
+        scores_max and eb_max and (scores_max + margin) > (1 << 64) // eb_max
+    )
+
+
+def _device_rewards_and_inactivity(state, spec: ChainSpec, E, fork: ForkName, arrays):
+    """Fused device pass replacing process_inactivity_updates +
+    process_rewards_and_penalties_altair (bit-exact parity is enforced by
+    tests/test_device_epoch_sweep.py in an isolated x64 process)."""
+    import numpy as _np
+
+    from ..ops.epoch_sweep import epoch_sweep  # enables x64 on import
+    from .per_epoch import get_finality_delay
+
+    current = get_current_epoch(state, E)
+    previous = get_previous_epoch(state, E)
+    curr_active = arrays.active_at(current)
+    total_active = max(
+        int(arrays.effective_balance[curr_active].sum(dtype=_np.uint64)),
+        E.EFFECTIVE_BALANCE_INCREMENT,
+    )
+    quotient = (
+        E.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+        if fork >= ForkName.BELLATRIX
+        else E.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    )
+    scalars = _np.array(
+        [
+            previous,
+            current,
+            E.EFFECTIVE_BALANCE_INCREMENT
+            * E.BASE_REWARD_FACTOR
+            // int_sqrt(total_active),
+            total_active // E.EFFECTIVE_BALANCE_INCREMENT,
+            int(get_finality_delay(state, E) > E.MIN_EPOCHS_TO_INACTIVITY_PENALTY),
+            spec.inactivity_score_bias,
+            spec.inactivity_score_recovery_rate,
+            spec.inactivity_score_bias * quotient,
+        ],
+        dtype=_np.uint64,
+    )
+    prev_flags = arrays.prev_participation
+    scores = _np.fromiter(
+        state.inactivity_scores, dtype=_np.uint64, count=arrays.n
+    )
+    balances = _np.fromiter(state.balances, dtype=_np.uint64, count=arrays.n)
+    new_balances, new_scores = epoch_sweep(
+        arrays.effective_balance,
+        arrays.slashed,
+        arrays.activation_epoch,
+        arrays.exit_epoch,
+        arrays.withdrawable_epoch,
+        prev_flags,
+        scores,
+        balances,
+        scalars,
+    )
+    state.inactivity_scores[:] = [int(v) for v in new_scores]
+    state.balances[:] = [int(v) for v in new_balances]
+
+
 def process_epoch_altair(state, spec: ChainSpec, E, fork: ForkName):
     """Altair+ epoch transition (per_epoch_processing/altair.rs:55)."""
     from .per_epoch import (
@@ -640,8 +723,13 @@ def process_epoch_altair(state, spec: ChainSpec, E, fork: ForkName):
 
     arrays = EpochArrays(state, E)
     process_justification_and_finalization_altair(state, E, arrays)
-    process_inactivity_updates(state, spec, E, arrays)
-    process_rewards_and_penalties_altair(state, spec, E, fork, arrays)
+    if _device_sweep_enabled() and _device_sweep_applicable(
+        state, arrays, spec, E
+    ):
+        _device_rewards_and_inactivity(state, spec, E, fork, arrays)
+    else:
+        process_inactivity_updates(state, spec, E, arrays)
+        process_rewards_and_penalties_altair(state, spec, E, fork, arrays)
     changed = process_registry_updates(state, spec, E, arrays=arrays)
     # one shared snapshot per epoch: registry updates report the touched
     # rows and the columns refresh in place (no second full rebuild)

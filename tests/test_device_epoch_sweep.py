@@ -1,0 +1,93 @@
+"""Device epoch sweep parity (single_pass.rs:20 on device, SURVEY §7.3).
+
+The fused jitted rewards/inactivity pass must be BIT-EXACT against the
+numpy reference sweep. x64 mode is process-global, so the device run
+happens in an isolated subprocess (same pattern as the multichip
+dryrun); the oracle runs here."""
+
+import json
+import os
+import subprocess
+import sys
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SUBPROC = r"""
+import json, os, sys
+sys.path.insert(0, sys.argv[1])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["LIGHTHOUSE_TPU_DEVICE_EPOCH_SWEEP"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dataclasses import replace
+from lighthouse_tpu.crypto import bls
+bls.set_backend("fake_crypto")
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+from lighthouse_tpu.state_processing import per_slot_processing
+from lighthouse_tpu.state_processing.altair import (
+    EpochArrays,
+    _device_sweep_applicable,
+    _device_sweep_enabled,
+)
+
+assert _device_sweep_enabled()
+spec = replace(minimal_spec(), altair_fork_epoch=0)
+h = BeaconChainHarness(spec, E, validator_count=16)
+h.extend_chain(3 * E.SLOTS_PER_EPOCH)  # real participation + an epoch miss mix
+st = h.chain.head_state.copy()
+# the device path must ACTUALLY run — a vacuous numpy-vs-numpy pass
+# would hide real divergence
+assert _device_sweep_applicable(st, EpochArrays(st, E), spec, E)
+# cross the next epoch boundary: epoch processing runs the DEVICE sweep
+target = (st.slot // E.SLOTS_PER_EPOCH + 1) * E.SLOTS_PER_EPOCH
+while st.slot < target:
+    per_slot_processing(st, spec, E)
+print(json.dumps({
+    "root": st.hash_tree_root().hex(),
+    "balances": [int(b) for b in st.balances][:4],
+    "scores": [int(s) for s in st.inactivity_scores][:4],
+}))
+"""
+
+
+@pytest.mark.slow
+def test_device_sweep_bit_exact_vs_numpy():
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, E, validator_count=16)
+    h.extend_chain(3 * E.SLOTS_PER_EPOCH)
+    st = h.chain.head_state.copy()
+    from lighthouse_tpu.state_processing import per_slot_processing
+
+    target = (st.slot // E.SLOTS_PER_EPOCH + 1) * E.SLOTS_PER_EPOCH
+    while st.slot < target:
+        per_slot_processing(st, spec, E)  # numpy sweep (flag unset here)
+    oracle_root = st.hash_tree_root().hex()
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    res = subprocess.run(
+        [sys.executable, "-c", _SUBPROC, REPO],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    doc = json.loads(res.stdout.strip().splitlines()[-1])
+    assert doc["root"] == oracle_root, (
+        f"device sweep diverged: {doc} vs numpy root {oracle_root}"
+    )
+    assert doc["balances"] == [int(b) for b in st.balances][:4]
+    assert doc["scores"] == [int(s) for s in st.inactivity_scores][:4]
