@@ -292,6 +292,121 @@ def cmd_interop_keys(args):
 # ---------------------------------------------------------------------------
 
 
+def cmd_am(args):
+    """account_manager: wallet lifecycle + voluntary exits.
+
+    Mirrors the reference account_manager CLI (wallet new/list, validator
+    exit): EIP-2386 HD wallets on disk; exits are signed locally with the
+    validator keystore and submitted to a beacon node's pool over the
+    Beacon API (SSZ)."""
+    import json
+    import pathlib
+
+    from .crypto import bls
+    from .crypto.keystore import Keystore
+    from .crypto.wallet import Wallet
+
+    if args.am_cmd == "wallet-create":
+        seed = bytes.fromhex(args.seed) if args.seed else None
+        w = Wallet.create(
+            args.name, args.password, seed=seed, _fast_kdf=args.fast_kdf
+        )
+        out = pathlib.Path(args.dir)
+        out.mkdir(parents=True, exist_ok=True)
+        (out / f"{w.doc['uuid']}.json").write_text(w.to_json())
+        print(json.dumps({"uuid": w.doc["uuid"], "name": w.name}))
+        return 0
+    if args.am_cmd == "wallet-list":
+        out = []
+        for p in sorted(pathlib.Path(args.dir).glob("*.json")):
+            doc = json.loads(p.read_text())
+            if doc.get("type") == "hierarchical deterministic":
+                out.append(
+                    {
+                        "name": doc.get("name"),
+                        "uuid": doc.get("uuid"),
+                        "nextaccount": doc.get("nextaccount"),
+                    }
+                )
+        print(json.dumps(out, indent=2))
+        return 0
+    if args.am_cmd == "exit":
+        from urllib.request import Request, urlopen
+
+        from .types.chain_spec import Domain, compute_signing_root
+        from .types.containers import build_types
+
+        _spec, E = _load_spec(args.spec)
+        t = build_types(E)
+        ks = Keystore.from_json(pathlib.Path(args.keystore).read_text())
+        sk = bls.SecretKey(int.from_bytes(ks.decrypt(args.password), "big"))
+
+        from urllib.error import HTTPError
+
+        base = args.beacon_url.rstrip("/")
+        genesis = json.loads(
+            urlopen(f"{base}/eth/v1/beacon/genesis", timeout=10).read()
+        )["data"]
+        fork = json.loads(
+            urlopen(f"{base}/eth/v1/beacon/states/head/fork", timeout=10).read()
+        )["data"]
+        cfg = json.loads(
+            urlopen(f"{base}/eth/v1/config/spec", timeout=10).read()
+        )["data"]
+        gvr = bytes.fromhex(
+            genesis["genesis_validators_root"].removeprefix("0x")
+        )
+        # EIP-7044: Deneb+ nodes verify exits over the CAPELLA fork domain
+        # forever; pre-Deneb the domain follows the exit's own epoch
+        # (previous_version when it predates the head fork) — mirror
+        # exit_signature_set exactly or the node rejects the signature
+        deneb_epoch = int(cfg.get("DENEB_FORK_EPOCH", 1 << 62))
+        head = json.loads(
+            urlopen(f"{base}/eth/v1/beacon/headers/head", timeout=10).read()
+        )["data"]
+        head_epoch = int(head["header"]["message"]["slot"]) // E.SLOTS_PER_EPOCH
+        if head_epoch >= deneb_epoch and "CAPELLA_FORK_VERSION" in cfg:
+            fork_version = bytes.fromhex(
+                cfg["CAPELLA_FORK_VERSION"].removeprefix("0x")
+            )
+        elif args.epoch < int(fork["epoch"]):
+            fork_version = bytes.fromhex(
+                fork["previous_version"].removeprefix("0x")
+            )
+        else:
+            fork_version = bytes.fromhex(
+                fork["current_version"].removeprefix("0x")
+            )
+        exit_msg = t.VoluntaryExit(
+            epoch=args.epoch, validator_index=args.validator_index
+        )
+        domain = _spec.compute_domain_from_parts(
+            Domain.VOLUNTARY_EXIT, fork_version, gvr
+        )
+        root = compute_signing_root(exit_msg.hash_tree_root(), domain)
+        signed = t.SignedVoluntaryExit(
+            message=exit_msg, signature=sk.sign(root).to_bytes()
+        )
+        req = Request(
+            f"{base}/eth/v1/beacon/pool/voluntary_exits",
+            data=signed.serialize(),
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        try:
+            resp = json.loads(urlopen(req, timeout=10).read())
+        except HTTPError as e:
+            # rejection replies are 4xx with a JSON body explaining why
+            body = e.read()
+            try:
+                print(json.dumps(json.loads(body)))
+            except ValueError:
+                print(body.decode(errors="replace"))
+            return 1
+        print(json.dumps(resp))
+        return 0 if resp.get("code") == 200 else 1
+    raise SystemExit(f"unknown am command {args.am_cmd}")
+
+
 def cmd_boot_node(args):
     """Standalone discovery bootstrap server (the boot_node crate,
     boot_node/src/lib.rs:1): runs the discv5-analog UDP discovery stack
@@ -336,6 +451,21 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--fake-crypto", action="store_true")
     bn.add_argument("--run-for", type=float, default=None, help="seconds then exit")
     bn.set_defaults(fn=cmd_beacon_node)
+
+    am = sub.add_parser("am", help="account manager (wallets, exits)")
+    am.add_argument(
+        "am_cmd", choices=["wallet-create", "wallet-list", "exit"]
+    )
+    am.add_argument("--dir", default=".")
+    am.add_argument("--name", default="wallet")
+    am.add_argument("--password", default="")
+    am.add_argument("--seed", default=None, help="hex seed (random if unset)")
+    am.add_argument("--fast-kdf", action="store_true")
+    am.add_argument("--keystore")
+    am.add_argument("--validator-index", type=int, default=0)
+    am.add_argument("--epoch", type=int, default=0)
+    am.add_argument("--beacon-url", default="http://127.0.0.1:5052")
+    am.set_defaults(fn=cmd_am)
 
     boot = sub.add_parser("boot-node", help="standalone discovery bootstrap")
     boot.add_argument("--listen-address", default="127.0.0.1")

@@ -45,9 +45,10 @@ def epoch_sweep(
     prev_flags,  # [n] u8 previous-epoch participation
     scores,  # [n] u64 inactivity scores
     balances,  # [n] u64
-    scalars,  # [8] u64: prev_epoch, curr_epoch, base_reward_per_increment,
+    scalars,  # [9] u64: prev_epoch, curr_epoch, base_reward_per_increment,
     #                total_active_increments, in_leak, score_bias,
-    #                score_recovery, inactivity_denom_lo — see host wrapper
+    #                score_recovery, inactivity_denom,
+    #                effective_balance_increment — see host wrapper
 ):
     prev_epoch = scalars[0]
     curr_epoch = scalars[1]
@@ -57,6 +58,7 @@ def epoch_sweep(
     score_bias = scalars[5]
     score_recovery = scalars[6]
     inactivity_denom = scalars[7]
+    eb_increment = scalars[8]
 
     u64 = jnp.uint64
     one = jnp.uint64(1)
@@ -69,7 +71,7 @@ def epoch_sweep(
     del curr_active  # totals are precomputed on host (traced scalars)
     eligible = prev_active | (slashed & (prev_epoch + one < withdrawable_epoch))
 
-    eb_increments = effective_balance // u64(1_000_000_000)
+    eb_increments = effective_balance // eb_increment
     base_rewards = eb_increments * base_reward_per_increment
 
     rewards = jnp.zeros_like(balances)
@@ -83,9 +85,9 @@ def epoch_sweep(
         participating = unslashed_participating(flag_index)
         upb = jnp.maximum(
             jnp.sum(jnp.where(participating, effective_balance, u64(0))),
-            u64(1_000_000_000),
+            eb_increment,
         )
-        upb_increments = upb // u64(1_000_000_000)
+        upb_increments = upb // eb_increment
         got_flag = eligible & participating
         numer = base_rewards * u64(weight) * upb_increments
         flag_reward = numer // (

@@ -687,6 +687,7 @@ def _device_rewards_and_inactivity(state, spec: ChainSpec, E, fork: ForkName, ar
             spec.inactivity_score_bias,
             spec.inactivity_score_recovery_rate,
             spec.inactivity_score_bias * quotient,
+            E.EFFECTIVE_BALANCE_INCREMENT,
         ],
         dtype=_np.uint64,
     )
@@ -706,8 +707,10 @@ def _device_rewards_and_inactivity(state, spec: ChainSpec, E, fork: ForkName, ar
         balances,
         scalars,
     )
-    state.inactivity_scores[:] = [int(v) for v in new_scores]
-    state.balances[:] = [int(v) for v in new_balances]
+    # ONE bulk device→host transfer each (per-element int() would sync
+    # once per validator)
+    state.inactivity_scores[:] = _np.asarray(new_scores).tolist()
+    state.balances[:] = _np.asarray(new_balances).tolist()
 
 
 def process_epoch_altair(state, spec: ChainSpec, E, fork: ForkName):

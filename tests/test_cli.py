@@ -133,3 +133,64 @@ def test_db_migrate_v1_blob_prefix(tmp_path, capsys):
         == 2
     )
     store.close()
+
+
+def test_am_wallet_and_exit_flow(tmp_path, capsys):
+    """account_manager analog: wallet create/list on disk; a voluntary
+    exit signed from a keystore and submitted over the Beacon API lands
+    in the pool and in the next produced block."""
+    from dataclasses import replace as _replace
+
+    from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+    from lighthouse_tpu.crypto.keystore import Keystore
+    from lighthouse_tpu.http_api import HttpApiServer
+
+    # wallets
+    wdir = tmp_path / "wallets"
+    assert main([
+        "am", "wallet-create", "--dir", str(wdir), "--name", "w1",
+        "--password", "pw", "--seed", "11" * 32, "--fast-kdf",
+    ]) == 0
+    created = json.loads(capsys.readouterr().out)
+    assert created["name"] == "w1"
+    assert main(["am", "wallet-list", "--dir", str(wdir)]) == 0
+    listed = json.loads(capsys.readouterr().out)
+    assert [w["name"] for w in listed] == ["w1"]
+
+    # exit: chain where exits are immediately eligible
+    bls.set_backend("host")
+    try:
+        spec = _replace(
+            minimal_spec(), altair_fork_epoch=0, shard_committee_period=0
+        )
+        h = BeaconChainHarness(spec, E, validator_count=8)
+        h.extend_chain(2)
+        srv = HttpApiServer(h.chain).start()
+        try:
+            kp = h.keypairs[3]
+            ks = Keystore.encrypt(
+                kp.sk.scalar.to_bytes(32, "big"), "pw",
+                pubkey=kp.pk.to_bytes(), _fast_kdf=True,
+            )
+            ks_path = tmp_path / "v3.json"
+            ks_path.write_text(ks.to_json())
+            rc = main([
+                "--spec", "minimal", "am", "exit",
+                "--keystore", str(ks_path), "--password", "pw",
+                "--validator-index", "3", "--epoch", "0",
+                "--beacon-url", f"http://127.0.0.1:{srv.port}",
+            ])
+            assert rc == 0
+            assert json.loads(capsys.readouterr().out)["code"] == 200
+            assert 3 in h.chain.op_pool._voluntary_exits
+            # packed into the next block
+            slot = h.chain.head_state.slot + 1
+            h.slot_clock.set_slot(slot)
+            h.add_block_at_slot(slot)
+            assert h.chain.head_state.validators[3].exit_epoch != (
+                (1 << 64) - 1
+            )
+        finally:
+            srv.stop()
+    finally:
+        bls.set_backend("fake_crypto")
