@@ -32,6 +32,18 @@ class WatchDB:
             "CREATE TABLE IF NOT EXISTS gaps ("
             "lo INTEGER, hi INTEGER)"
         )
+        # block-packing + participation analytics (watch's block_packing /
+        # suboptimal_attestations tables)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS block_packing ("
+            "slot INTEGER PRIMARY KEY, attestation_count INTEGER, "
+            "attester_votes INTEGER, sync_bits INTEGER, sync_size INTEGER)"
+        )
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS suboptimal_attestations ("
+            "att_slot INTEGER, included_at INTEGER, delay INTEGER, "
+            "PRIMARY KEY (att_slot, included_at))"
+        )
         self._conn.commit()
 
     def record_gap(self, lo: int, hi: int):
@@ -90,6 +102,52 @@ class WatchDB:
         ).fetchone()
         return row[0] if row[0] is not None else -1
 
+    def record_packing(
+        self, slot: int, att_count: int, attester_votes: int,
+        sync_bits: int, sync_size: int, suboptimal_rows=(),
+    ):
+        """One transaction per block: the packing row plus its suboptimal
+        attestations (idempotent — re-walked boundary blocks replace
+        rather than duplicate)."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO block_packing VALUES (?, ?, ?, ?, ?)",
+                (slot, att_count, attester_votes, sync_bits, sync_size),
+            )
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO suboptimal_attestations "
+                "VALUES (?, ?, ?)",
+                list(suboptimal_rows),
+            )
+            self._conn.commit()
+
+    def packing_stats(self) -> dict:
+        """Aggregate block-packing view (server.rs block_packing route).
+        Returns the suboptimal count from the SAME locked snapshot so the
+        REST response is internally consistent vs a concurrent updater."""
+        with self._lock:
+            sub = self._conn.execute(
+                "SELECT COUNT(*) FROM suboptimal_attestations"
+            ).fetchone()[0]
+            row = self._conn.execute(
+            "SELECT COUNT(*), AVG(attestation_count), AVG(attester_votes), "
+            "AVG(CAST(sync_bits AS REAL) / NULLIF(sync_size, 0)) "
+                "FROM block_packing"
+            ).fetchone()
+        return {
+            "blocks": row[0],
+            "avg_attestations": row[1] or 0.0,
+            "avg_attester_votes": row[2] or 0.0,
+            "avg_sync_participation": row[3] or 0.0,
+            "suboptimal_attestations": sub,
+        }
+
+    def suboptimal_attestation_count(self) -> int:
+        with self._lock:
+            return self._conn.execute(
+                "SELECT COUNT(*) FROM suboptimal_attestations"
+            ).fetchone()[0]
+
 
 class WatchUpdater:
     """Polls the node and fills the DB (updater.rs)."""
@@ -119,6 +177,7 @@ class WatchUpdater:
                 signed.message.hash_tree_root(),
                 int(signed.message.proposer_index),
             )
+            self._record_packing(signed)
             parent = bytes(signed.message.parent_root)
             if slot <= max(start, 1) or parent == b"\x00" * 32:
                 walk_complete = True
@@ -154,3 +213,77 @@ class WatchUpdater:
             int(fin["finalized"]["epoch"]),
         )
         return recorded
+
+    def _record_packing(self, signed):
+        """Per-block packing + suboptimal-attestation analytics
+        (updater's block_packing / attestation passes)."""
+        m = signed.message
+        body = m.body
+        att_count = len(body.attestations)
+        votes = sum(sum(a.aggregation_bits) for a in body.attestations)
+        agg = getattr(body, "sync_aggregate", None)
+        sync_bits = sum(agg.sync_committee_bits) if agg is not None else 0
+        sync_size = len(agg.sync_committee_bits) if agg is not None else 0
+        suboptimal = [
+            (int(a.data.slot), int(m.slot), int(m.slot) - int(a.data.slot))
+            for a in body.attestations
+            if int(m.slot) - int(a.data.slot) > 1
+        ]
+        self.db.record_packing(
+            int(m.slot), att_count, votes, sync_bits, sync_size,
+            suboptimal_rows=suboptimal,
+        )
+
+
+class WatchServer:
+    """REST surface over the DB (watch/src/server): /v1/slots/missed,
+    /v1/proposers, /v1/finality, /v1/packing, /v1/gaps."""
+
+    def __init__(self, db: WatchDB, port: int = 0):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        watch_db = db
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                routes = {
+                    "/v1/slots/missed": lambda: watch_db.missed_slots(),
+                    "/v1/proposers": lambda: {
+                        str(k): v for k, v in watch_db.proposer_counts().items()
+                    },
+                    "/v1/finality": lambda: watch_db.latest_finality(),
+                    "/v1/packing": lambda: watch_db.packing_stats(),
+                    "/v1/gaps": lambda: watch_db.gaps(),
+                }
+                fn = routes.get(self.path.split("?")[0])
+                if fn is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = _json.dumps(fn()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self.port = self._server.server_port
+        self._thread = None
+
+    def start(self) -> "WatchServer":
+        import threading as _threading
+
+        self._thread = _threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="watch-server"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

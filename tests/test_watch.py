@@ -1,5 +1,6 @@
 """watch analytics: updater fills the DB from a live node over HTTP."""
 
+import json
 from dataclasses import replace
 
 from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
@@ -38,5 +39,48 @@ def test_watch_updater_records_chain():
         h.add_block_at_slot(skip_to)
         assert updater.update() == 2
         assert db.missed_slots() == [skip_to - 1]
+    finally:
+        server.stop()
+
+
+def test_watch_packing_and_rest_server():
+    """Block-packing analytics + the watch REST surface (server.rs)."""
+    import urllib.request
+
+    from lighthouse_tpu.watch import WatchDB, WatchServer, WatchUpdater
+
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, E, validator_count=16)
+    h.extend_chain(E.SLOTS_PER_EPOCH + 2)
+    server = HttpApiServer(h.chain).start()
+    try:
+        client = BeaconNodeHttpClient(f"http://127.0.0.1:{server.port}")
+        db = WatchDB()
+        WatchUpdater(client, db, build_types(E)).update()
+        stats = db.packing_stats()
+        assert stats["blocks"] == E.SLOTS_PER_EPOCH + 2
+        assert stats["avg_attestations"] > 0  # harness attests each slot
+        assert 0 < stats["avg_sync_participation"] <= 1.0
+
+        ws = WatchServer(db).start()
+        try:
+            base = f"http://127.0.0.1:{ws.port}"
+            packing = json.loads(
+                urllib.request.urlopen(f"{base}/v1/packing", timeout=5).read()
+            )
+            assert packing["blocks"] == stats["blocks"]
+            proposers = json.loads(
+                urllib.request.urlopen(f"{base}/v1/proposers", timeout=5).read()
+            )
+            assert sum(proposers.values()) == E.SLOTS_PER_EPOCH + 2
+            missed = json.loads(
+                urllib.request.urlopen(
+                    f"{base}/v1/slots/missed", timeout=5
+                ).read()
+            )
+            assert missed == []
+        finally:
+            ws.stop()
     finally:
         server.stop()
