@@ -64,6 +64,12 @@ class ValidatorStore:
     def pubkeys(self):
         return list(self._signers)
 
+    def remove_validator(self, pubkey: bytes) -> bool:
+        """Detach a signer (keymanager DELETE). The slashing-protection
+        history for the key is retained intentionally — it must survive
+        into the interchange export the operator migrates with."""
+        return self._signers.pop(bytes(pubkey), None) is not None
+
     def signer_for(self, pubkey: bytes) -> SigningMethod | None:
         return self._signers.get(bytes(pubkey))
 
@@ -525,6 +531,8 @@ class PreparationService:
 
     def set_fee_recipient(self, pubkey: bytes, recipient: bytes):
         self.per_validator[bytes(pubkey)] = bytes(recipient)
+        # any recipient change re-registers with the BN at the next tick
+        self._registered_epoch = -1
 
     def prepare(self, epoch: int):
         """Once per epoch: push {validator_index: fee_recipient}."""

@@ -54,7 +54,10 @@ class WatchDB:
             self._conn.commit()
 
     def gaps(self) -> list[tuple[int, int]]:
-        return self._conn.execute("SELECT lo, hi FROM gaps ORDER BY lo").fetchall()
+        with self._lock:
+            return self._conn.execute(
+                "SELECT lo, hi FROM gaps ORDER BY lo"
+            ).fetchall()
 
     def record_slot(self, slot: int, root: bytes | None, proposer: int | None):
         with self._lock:
@@ -75,31 +78,33 @@ class WatchDB:
     # -- queries (server.rs routes) -------------------------------------------
 
     def proposer_counts(self) -> dict[int, int]:
-        rows = self._conn.execute(
-            "SELECT proposer, COUNT(*) FROM canonical_slots "
-            "WHERE skipped = 0 GROUP BY proposer"
-        ).fetchall()
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT proposer, COUNT(*) FROM canonical_slots "
+                "WHERE skipped = 0 GROUP BY proposer"
+            ).fetchall()
         return {p: c for p, c in rows}
 
     def missed_slots(self) -> list[int]:
-        return [
-            r[0]
-            for r in self._conn.execute(
-                "SELECT slot FROM canonical_slots WHERE skipped = 1 ORDER BY slot"
-            )
-        ]
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT slot FROM canonical_slots WHERE skipped = 1 "
+                "ORDER BY slot"
+            ).fetchall()
+        return [r[0] for r in rows]
 
     def latest_finality(self) -> tuple[int, int] | None:
-        row = self._conn.execute(
-            "SELECT justified_epoch, finalized_epoch FROM finality "
-            "ORDER BY checked_at_slot DESC LIMIT 1"
-        ).fetchone()
-        return row
+        with self._lock:
+            return self._conn.execute(
+                "SELECT justified_epoch, finalized_epoch FROM finality "
+                "ORDER BY checked_at_slot DESC LIMIT 1"
+            ).fetchone()
 
     def highest_slot(self) -> int:
-        row = self._conn.execute(
-            "SELECT MAX(slot) FROM canonical_slots"
-        ).fetchone()
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT MAX(slot) FROM canonical_slots"
+            ).fetchone()
         return row[0] if row[0] is not None else -1
 
     def record_packing(
@@ -168,6 +173,7 @@ class WatchUpdater:
             return 0
         # walk the canonical chain backward from head to `start`
         blocks_by_slot: dict[int, tuple] = {}
+        packing_jobs: list = []
         data = self.client.get_block_ssz("head")
         signed = self.types.decode_by_fork("SignedBeaconBlock", data)
         walk_complete = False
@@ -177,7 +183,7 @@ class WatchUpdater:
                 signed.message.hash_tree_root(),
                 int(signed.message.proposer_index),
             )
-            self._record_packing(signed)
+            packing_jobs.append(signed)
             parent = bytes(signed.message.parent_root)
             if slot <= max(start, 1) or parent == b"\x00" * 32:
                 walk_complete = True
@@ -206,6 +212,8 @@ class WatchUpdater:
             else:
                 continue  # hole: history unavailable, leave unrecorded
             recorded += 1
+        for signed in packing_jobs:
+            self._record_packing(signed, blocks_by_slot)
         fin = self.client.get_finality_checkpoints("head")
         self.db.record_finality(
             head_slot,
@@ -214,9 +222,12 @@ class WatchUpdater:
         )
         return recorded
 
-    def _record_packing(self, signed):
+    def _record_packing(self, signed, blocks_by_slot):
         """Per-block packing + suboptimal-attestation analytics
-        (updater's block_packing / attestation passes)."""
+        (updater's block_packing / attestation passes). An attestation is
+        suboptimal only when an EARLIER canonical block could have carried
+        it — skipped slots between its slot and its inclusion don't count
+        against it."""
         m = signed.message
         body = m.body
         att_count = len(body.attestations)
@@ -228,6 +239,10 @@ class WatchUpdater:
             (int(a.data.slot), int(m.slot), int(m.slot) - int(a.data.slot))
             for a in body.attestations
             if int(m.slot) - int(a.data.slot) > 1
+            and any(
+                s in blocks_by_slot
+                for s in range(int(a.data.slot) + 1, int(m.slot))
+            )
         ]
         self.db.record_packing(
             int(m.slot), att_count, votes, sync_bits, sync_size,
@@ -264,7 +279,16 @@ class WatchServer:
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = _json.dumps(fn()).encode()
+                try:
+                    body = _json.dumps(fn()).encode()
+                except Exception as e:  # noqa: BLE001 — 500, not a reset
+                    body = _json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))

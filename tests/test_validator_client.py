@@ -251,3 +251,81 @@ def test_doppelganger_gates_signing():
     later_slot = 2 * E.SLOTS_PER_EPOCH + 1
     h.slot_clock.set_slot(later_slot)
     assert vc.doppelganger.signing_enabled(2)
+
+
+def test_keymanager_api_lifecycle():
+    """VC keymanager HTTP API (validator_client/src/http_api): bearer
+    auth, list/import/delete keystores with interchange export, fee
+    recipient get/set feeding the preparation service."""
+    import json as _json
+    import urllib.request
+    from urllib.error import HTTPError
+
+    from lighthouse_tpu.crypto.keystore import Keystore
+    from lighthouse_tpu.validator_client.http_api import KeymanagerServer
+
+    h, vc = _vc_setup(validator_count=4)
+    srv = KeymanagerServer(vc).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def call(method, path, body=None, token=srv.token):
+        req = urllib.request.Request(
+            f"{base}{path}",
+            data=_json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers={
+                "Authorization": f"Bearer {token}",
+                "Content-Type": "application/json",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, _json.loads(r.read())
+
+    try:
+        # auth required
+        try:
+            call("GET", "/eth/v1/keystores", token="wrong")
+            raise AssertionError("unauthenticated request accepted")
+        except HTTPError as e:
+            assert e.code == 401
+
+        _code, listed = call("GET", "/eth/v1/keystores")
+        assert len(listed["data"]) == 4
+
+        # import a 5th key
+        kp5 = bls.interop_keypairs(6)[5]
+        ks = Keystore.encrypt(
+            kp5.sk.scalar.to_bytes(32, "big"), "pw",
+            pubkey=kp5.pk.to_bytes(), _fast_kdf=True,
+        )
+        _code, res = call(
+            "POST", "/eth/v1/keystores",
+            {"keystores": [ks.to_json()], "passwords": ["pw"]},
+        )
+        assert res["data"] == [{"status": "imported"}]
+        assert len(call("GET", "/eth/v1/keystores")[1]["data"]) == 5
+        # duplicate import reports duplicate
+        _code, res = call(
+            "POST", "/eth/v1/keystores",
+            {"keystores": [ks.to_json()], "passwords": ["pw"]},
+        )
+        assert res["data"] == [{"status": "duplicate"}]
+
+        # fee recipient set/get drives the preparation service
+        pk_hex = "0x" + kp5.pk.to_bytes().hex()
+        code, _ = call(
+            "POST", f"/eth/v1/validator/{pk_hex}/feerecipient",
+            {"ethaddress": "0x" + "ee" * 20},
+        )
+        assert code == 202
+        _code, fr = call("GET", f"/eth/v1/validator/{pk_hex}/feerecipient")
+        assert fr["data"]["ethaddress"] == "0x" + "ee" * 20
+
+        # delete exports slashing protection
+        _code, res = call("DELETE", "/eth/v1/keystores", {"pubkeys": [pk_hex]})
+        assert res["data"] == [{"status": "deleted"}]
+        interchange = _json.loads(res["slashing_protection"])
+        assert "metadata" in interchange
+        assert len(call("GET", "/eth/v1/keystores")[1]["data"]) == 4
+    finally:
+        srv.stop()
