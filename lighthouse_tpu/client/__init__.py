@@ -151,11 +151,13 @@ class ClientBuilder:
             from ..network import NetworkService
 
             c.network = NetworkService(c.chain, port=cfg.network_port)
-        # http
+        # http (identity/peers routes read the network when present)
         if cfg.http_port is not None:
             from ..http_api import HttpApiServer
 
-            c.http_server = HttpApiServer(c.chain, port=cfg.http_port)
+            c.http_server = HttpApiServer(
+                c.chain, port=cfg.http_port, network=c.network
+            )
         # validator client
         if cfg.validate:
             from ..validator_client import ValidatorClient

@@ -59,9 +59,10 @@ class BeaconApi:
     """Route implementations over a BeaconChain (transport-independent —
     the HTTP layer and tests call these directly)."""
 
-    def __init__(self, chain, validator_client=None):
+    def __init__(self, chain, validator_client=None, network=None):
         self.chain = chain
         self.vc = validator_client
+        self.network = network
         # genesis facts from chain invariants — never from the prunable
         # snapshot cache (the API may be constructed after finality)
         self._genesis_time = int(chain.head_state.genesis_time)
@@ -128,6 +129,58 @@ class BeaconApi:
 
     def node_health(self):
         return 200
+
+    def node_identity(self):
+        """GET /eth/v1/node/identity: this node's network identity (enr /
+        peer id / listen addresses) when a network is attached."""
+        net = self.network
+        if net is None:
+            return {
+                "data": {
+                    "peer_id": "", "enr": "", "p2p_addresses": [],
+                    "discovery_addresses": [],
+                    "metadata": {"seq_number": "0", "attnets": "0x00"},
+                }
+            }
+        enr = (
+            json.dumps(net.discovery.local_enr.to_dict())
+            if net.discovery is not None
+            else ""
+        )
+        return {
+            "data": {
+                "peer_id": f"127.0.0.1:{net.port}",
+                "enr": enr,
+                "p2p_addresses": [f"/ip4/127.0.0.1/tcp/{net.port}"],
+                "discovery_addresses": (
+                    [f"/ip4/127.0.0.1/udp/{net.discovery.udp_port}"]
+                    if net.discovery is not None
+                    else []
+                ),
+                "metadata": {
+                    "seq_number": str(net.metadata_seq),
+                    "attnets": "0x00",
+                },
+            }
+        }
+
+    def node_peers(self):
+        """GET /eth/v1/node/peers."""
+        net = self.network
+        peers = net.peers.peers() if net is not None else []
+        return {
+            "data": [
+                {
+                    "peer_id": p.peer_id,
+                    "state": "connected",
+                    "direction": "outbound",
+                    "last_seen_p2p_address": f"/ip4/{p.host}/tcp/{p.port}",
+                    "score": p.score,
+                }
+                for p in peers
+            ],
+            "meta": {"count": len(peers)},
+        }
 
     def node_syncing(self):
         head = self.chain.head_state.slot
@@ -664,6 +717,8 @@ class BeaconApi:
 _ROUTES = [
     ("GET", r"^/eth/v1/node/version$", "node_version"),
     ("GET", r"^/eth/v1/node/syncing$", "node_syncing"),
+    ("GET", r"^/eth/v1/node/identity$", "node_identity"),
+    ("GET", r"^/eth/v1/node/peers$", "node_peers"),
     ("GET", r"^/eth/v1/beacon/genesis$", "genesis"),
     ("GET", r"^/eth/v1/beacon/states/(?P<state_id>[^/]+)/root$", "state_root"),
     ("GET", r"^/eth/v1/beacon/states/(?P<state_id>[^/]+)/fork$", "state_fork"),
@@ -914,8 +969,8 @@ class _Handler(BaseHTTPRequestHandler):
 class HttpApiServer:
     """Threaded HTTP server bound to localhost (warp analog)."""
 
-    def __init__(self, chain, port: int = 0):
-        self.api = BeaconApi(chain)
+    def __init__(self, chain, port: int = 0, network=None):
+        self.api = BeaconApi(chain, network=network)
         handler = type("BoundHandler", (_Handler,), {"api": self.api})
         self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._server.server_address[1]

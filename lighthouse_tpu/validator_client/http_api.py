@@ -4,18 +4,18 @@ The validator_client/src/http_api analog (EIP-3030-era keymanager
 standard): a small authenticated HTTP server on the VC exposing
 GET/POST/DELETE /eth/v1/keystores plus the fee-recipient routes, so
 operators manage keys without touching the VC's disk. Auth follows the
-reference: a bearer token generated at startup (api-token.txt) required
-on every request."""
+reference: a bearer token required on every request — generated at
+startup and written to `token_path` (the reference's api-token.txt) when
+one is configured, else exposed via `.token`."""
 
 from __future__ import annotations
 
 import json
 import secrets
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..crypto import bls
 from ..crypto.keystore import Keystore
+from ..utils.http_server import JsonHttpServer, JsonRequestHandler
 from ..utils.logging import get_logger
 from . import LocalKeystoreSigner
 
@@ -103,56 +103,54 @@ class KeymanagerApi:
         )
 
 
-class KeymanagerServer:
-    def __init__(self, vc, port: int = 0, token: str | None = None):
+class KeymanagerServer(JsonHttpServer):
+    def __init__(
+        self,
+        vc,
+        port: int = 0,
+        token: str | None = None,
+        token_path: str | None = None,
+    ):
         self.api = KeymanagerApi(vc)
         self.token = token or secrets.token_hex(32)
+        if token_path:
+            with open(token_path, "w") as f:
+                f.write(self.token + "\n")
         api = self.api
         server = self
 
-        class _Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):
-                pass
-
+        class _Handler(JsonRequestHandler):
             def _authed(self) -> bool:
                 auth = self.headers.get("Authorization", "")
-                return secrets.compare_digest(auth, f"Bearer {server.token}")
-
-            @property
-            def route(self) -> str:
-                return self.path.split("?")[0]
-
-            def _send(self, obj, code=200):
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    return secrets.compare_digest(
+                        auth, f"Bearer {server.token}"
+                    )
+                except TypeError:
+                    return False  # non-ASCII header cannot be the token
 
             def do_GET(self):
                 if not self._authed():
-                    return self._send({"message": "unauthorized"}, 401)
+                    return self.send_json({"message": "unauthorized"}, 401)
                 try:
                     if self.route == "/eth/v1/keystores":
-                        return self._send(api.list_keystores())
+                        return self.send_json(api.list_keystores())
                     if self.route.startswith("/eth/v1/validator/") and (
                         self.route.endswith("/feerecipient")
                     ):
                         pk = self.route.split("/")[-2]
-                        return self._send(api.get_fee_recipient(pk))
-                    return self._send({"message": "not found"}, 404)
+                        return self.send_json(api.get_fee_recipient(pk))
+                    return self.send_json({"message": "not found"}, 404)
                 except Exception as e:  # noqa: BLE001
-                    return self._send({"message": str(e)}, 400)
+                    return self.send_json({"message": str(e)}, 400)
 
             def do_POST(self):
                 if not self._authed():
-                    return self._send({"message": "unauthorized"}, 401)
-                length = int(self.headers.get("Content-Length", 0))
+                    return self.send_json({"message": "unauthorized"}, 401)
                 try:
-                    body = json.loads(self.rfile.read(length) or b"{}")
+                    body = self.read_json_body()
                     if self.route == "/eth/v1/keystores":
-                        return self._send(
+                        return self.send_json(
                             api.import_keystores(
                                 body.get("keystores", []),
                                 body.get("passwords", []),
@@ -163,37 +161,27 @@ class KeymanagerServer:
                     ):
                         pk = self.route.split("/")[-2]
                         api.set_fee_recipient(pk, body["ethaddress"])
-                        return self._send({}, 202)
-                    return self._send({"message": "not found"}, 404)
+                        return self.send_json({}, 202)
+                    return self.send_json({"message": "not found"}, 404)
                 except Exception as e:  # noqa: BLE001
-                    return self._send({"message": str(e)}, 400)
+                    return self.send_json({"message": str(e)}, 400)
 
             def do_DELETE(self):
                 if not self._authed():
-                    return self._send({"message": "unauthorized"}, 401)
-                length = int(self.headers.get("Content-Length", 0))
+                    return self.send_json({"message": "unauthorized"}, 401)
                 try:
-                    body = json.loads(self.rfile.read(length) or b"{}")
+                    body = self.read_json_body()
                     if self.route == "/eth/v1/keystores":
-                        return self._send(
+                        return self.send_json(
                             api.delete_keystores(body.get("pubkeys", []))
                         )
-                    return self._send({"message": "not found"}, 404)
+                    return self.send_json({"message": "not found"}, 404)
                 except Exception as e:  # noqa: BLE001
-                    return self._send({"message": str(e)}, 400)
+                    return self.send_json({"message": str(e)}, 400)
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
-        self.port = self._server.server_port
-        self._thread: threading.Thread | None = None
+        super().__init__(_Handler, port=port, name="vc-keymanager")
 
     def start(self) -> "KeymanagerServer":
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True, name="vc-keymanager"
-        )
-        self._thread.start()
+        super().start()
         log.info("keymanager API up", port=self.port)
         return self
-
-    def stop(self):
-        self._server.shutdown()
-        self._server.server_close()

@@ -12,6 +12,7 @@ import sqlite3
 import threading
 
 from ..eth2 import BeaconNodeHttpClient
+from ..utils.http_server import JsonHttpServer, JsonRequestHandler
 
 
 class WatchDB:
@@ -147,6 +148,17 @@ class WatchDB:
             "suboptimal_attestations": sub,
         }
 
+    def has_block_between(self, lo: int, hi: int) -> bool:
+        """Any recorded canonical block at a slot in (lo, hi) exclusive —
+        re-walks consult this for history outside the fresh walk."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT 1 FROM canonical_slots WHERE skipped = 0 "
+                "AND slot > ? AND slot < ? LIMIT 1",
+                (lo, hi),
+            ).fetchone()
+        return row is not None
+
     def suboptimal_attestation_count(self) -> int:
         with self._lock:
             return self._conn.execute(
@@ -239,9 +251,15 @@ class WatchUpdater:
             (int(a.data.slot), int(m.slot), int(m.slot) - int(a.data.slot))
             for a in body.attestations
             if int(m.slot) - int(a.data.slot) > 1
-            and any(
-                s in blocks_by_slot
-                for s in range(int(a.data.slot) + 1, int(m.slot))
+            and (
+                any(
+                    s in blocks_by_slot
+                    for s in range(int(a.data.slot) + 1, int(m.slot))
+                )
+                # slots below the fresh walk live in the DB from earlier
+                # runs — without this, re-walked boundary blocks would
+                # REPLACE correct rows with false "optimal"
+                or self.db.has_block_between(int(a.data.slot), int(m.slot))
             )
         ]
         self.db.record_packing(
@@ -250,20 +268,14 @@ class WatchUpdater:
         )
 
 
-class WatchServer:
+class WatchServer(JsonHttpServer):
     """REST surface over the DB (watch/src/server): /v1/slots/missed,
     /v1/proposers, /v1/finality, /v1/packing, /v1/gaps."""
 
     def __init__(self, db: WatchDB, port: int = 0):
-        import json as _json
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
         watch_db = db
 
-        class _Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):
-                pass
-
+        class _Handler(JsonRequestHandler):
             def do_GET(self):
                 routes = {
                     "/v1/slots/missed": lambda: watch_db.missed_slots(),
@@ -274,40 +286,12 @@ class WatchServer:
                     "/v1/packing": lambda: watch_db.packing_stats(),
                     "/v1/gaps": lambda: watch_db.gaps(),
                 }
-                fn = routes.get(self.path.split("?")[0])
+                fn = routes.get(self.route)
                 if fn is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
+                    return self.send_json({"error": "not found"}, 404)
                 try:
-                    body = _json.dumps(fn()).encode()
+                    return self.send_json(fn())
                 except Exception as e:  # noqa: BLE001 — 500, not a reset
-                    body = _json.dumps({"error": str(e)}).encode()
-                    self.send_response(500)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                    return self.send_json({"error": str(e)}, 500)
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
-        self.port = self._server.server_port
-        self._thread = None
-
-    def start(self) -> "WatchServer":
-        import threading as _threading
-
-        self._thread = _threading.Thread(
-            target=self._server.serve_forever, daemon=True, name="watch-server"
-        )
-        self._thread.start()
-        return self
-
-    def stop(self):
-        self._server.shutdown()
-        self._server.server_close()
+        super().__init__(_Handler, port=port, name="watch-server")

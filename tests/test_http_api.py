@@ -197,3 +197,32 @@ def test_pool_and_blob_routes(rig):
         accept="application/octet-stream",
     )
     assert code == 200 and raw == b""
+
+
+def test_node_identity_and_peers_routes():
+    """node/identity + node/peers read the attached NetworkService."""
+    from lighthouse_tpu.network import NetworkService
+
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    a = BeaconChainHarness(spec, E, validator_count=8)
+    b = BeaconChainHarness(spec, E, validator_count=8)
+    na = NetworkService(a.chain).start()
+    nb = NetworkService(b.chain).start()
+    srv = HttpApiServer(a.chain, network=na).start()
+    try:
+        nb.connect("127.0.0.1", na.port)
+        import time as _t
+
+        _t.sleep(0.2)
+        _code, ident = _get(srv, "/eth/v1/node/identity")
+        assert ident["data"]["p2p_addresses"] == [
+            f"/ip4/127.0.0.1/tcp/{na.port}"
+        ]
+        _code, peers = _get(srv, "/eth/v1/node/peers")
+        assert peers["meta"]["count"] == 1
+        assert peers["data"][0]["state"] == "connected"
+    finally:
+        srv.stop()
+        na.stop()
+        nb.stop()
