@@ -193,10 +193,11 @@ def cmd_transition_blocks(args):
 
 def cmd_db(args):
     """database_manager: version / inspect / migrate."""
+    from .store import open_item_store
     from .store.hot_cold import CURRENT_SCHEMA_VERSION, SCHEMA_VERSION_KEY
-    from .store.kv import DBColumn, SqliteStore
+    from .store.kv import DBColumn
 
-    store = SqliteStore(args.path)
+    store = open_item_store(args.path, getattr(args, "db_backend", "auto"))
     try:
         if args.db_cmd == "version":
             raw = store.get(DBColumn.BEACON_META, SCHEMA_VERSION_KEY)
@@ -501,6 +502,12 @@ def build_parser() -> argparse.ArgumentParser:
     db = sub.add_parser("db", help="database manager")
     db.add_argument("db_cmd", choices=["version", "inspect", "migrate"])
     db.add_argument("path")
+    db.add_argument(
+        "--db-backend",
+        choices=["auto", "native", "sqlite"],
+        default="auto",
+        help="storage engine (native = the C++ LSM store)",
+    )
     db.set_defaults(fn=cmd_db)
 
     ik = sub.add_parser("interop-keys", help="deterministic test keypairs")

@@ -16,9 +16,22 @@ log = get_logger("slasher.service")
 
 
 class SlasherService:
-    def __init__(self, chain, slasher: Slasher | None = None):
+    def __init__(self, chain, slasher: Slasher | None = None, store=None):
         self.chain = chain
-        self.slasher = slasher or Slasher(chain.E)
+        if slasher is None:
+            # Persist detection history through the node's hot KV store
+            # (own columns — the reference keeps a dedicated LMDB; the
+            # ItemStore seam gives the same durability here). Memory-backed
+            # nodes skip write-through: serializing into a store that dies
+            # with the process is pure overhead.
+            if store is None and getattr(chain, "store", None) is not None:
+                from ..store.kv import MemoryStore
+
+                hot = chain.store.hot
+                if not isinstance(hot, MemoryStore):
+                    store = hot
+            slasher = Slasher(chain.E, store=store)
+        self.slasher = slasher
         self._last_processed_epoch = -1
         # hook into the chain's verification paths
         chain.slasher_service = self

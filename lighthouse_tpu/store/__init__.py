@@ -2,14 +2,52 @@
 
 `ItemStore` is the KV trait seam (store/src/lib.rs ItemStore/KeyValueStore);
 `MemoryStore` is the in-memory test backend (store/src/memory_store.rs);
-`SqliteStore` is a host-native persistent backend (stdlib sqlite3 — C under
-the hood — standing in for the reference's LevelDB until the C++ LSM store
-lands). `HotColdDB` splits recent (hot) data from finalized history (cold)
-at the split slot (store/src/hot_cold_store.rs:50-55).
+`SqliteStore` is a pure-host fallback (stdlib sqlite3); `NativeStore`
+(store/native.py + _native/lsm_store.cc) is the C++ LSM engine matching
+the reference's LevelDB/LMDB native storage (SURVEY §2.7 items 4/5).
+`HotColdDB` splits recent (hot) data from finalized history (cold) at the
+split slot (store/src/hot_cold_store.rs:50-55).
 """
 
 from .kv import DBColumn, ItemStore, MemoryStore, SqliteStore
 from .hot_cold import HotColdDB
+
+
+def open_item_store(path: str, backend: str = "auto") -> ItemStore:
+    """Open a persistent ItemStore at `path`.
+
+    backend: "native" (C++ LSM), "sqlite", or "auto" — native when the
+    toolchain can build it, sqlite otherwise.
+    """
+    if backend not in ("auto", "native", "sqlite"):
+        raise ValueError(f"unknown db backend {backend!r}")
+    if backend == "auto":
+        import os
+
+        # Existing layouts keep their engine: a sqlite DB is a regular
+        # file, a native store is a directory.
+        if os.path.isfile(path):
+            backend = "sqlite"
+        elif os.path.isdir(path):
+            backend = "native"
+    if backend in ("auto", "native"):
+        try:
+            from .native import NativeStore
+
+            return NativeStore(path)
+        except Exception:
+            if backend != "auto":
+                # an existing native store (or an explicit request) must
+                # not be silently re-routed to a different engine
+                raise
+            from ..utils.logging import get_logger
+
+            get_logger("lighthouse_tpu.store").warning(
+                "native store backend unavailable, falling back to sqlite",
+                exc_info=True,
+            )
+    return SqliteStore(path)
+
 
 __all__ = [
     "DBColumn",
@@ -17,4 +55,5 @@ __all__ = [
     "MemoryStore",
     "SqliteStore",
     "HotColdDB",
+    "open_item_store",
 ]

@@ -26,6 +26,9 @@ class DBColumn(str, Enum):
     ETH1_CACHE = "etc"
     HOT_STATE_SUMMARY = "hss"
     BLOB_SIDECARS = "blb"
+    SLASHER_ATTESTATION = "sat"
+    SLASHER_INDEXED = "sai"
+    SLASHER_BLOCK = "sbk"
 
 
 class ItemStore:

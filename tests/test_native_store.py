@@ -1,0 +1,250 @@
+"""Native C++ LSM store (store/_native/lsm_store.cc via store/native.py).
+
+Covers the properties the reference gets from LevelDB
+(beacon_node/store/src/leveldb_store.rs): durable point reads/writes,
+atomic multi-op batches (crash-atomicity simulated by truncating the WAL
+mid-record), ordered per-column iteration, compaction correctness, and a
+randomized model check against a plain dict.
+"""
+
+import os
+import random
+import struct
+
+import pytest
+
+from lighthouse_tpu.store import HotColdDB, open_item_store
+from lighthouse_tpu.store.kv import DBColumn, MemoryStore
+from lighthouse_tpu.store.native import NativeStore, native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+
+@pytest.fixture
+def db_path(tmp_path):
+    return str(tmp_path / "db")
+
+
+def test_round_trip_and_reopen(db_path):
+    s = NativeStore(db_path)
+    s.put(DBColumn.BEACON_BLOCK, b"a" * 32, b"block-bytes")
+    s.put(DBColumn.BEACON_STATE, b"a" * 32, b"state-bytes" * 1000)
+    assert s.get(DBColumn.BEACON_BLOCK, b"a" * 32) == b"block-bytes"
+    assert s.get(DBColumn.BEACON_BLOCK, b"b" * 32) is None
+    # column isolation: same key, different columns
+    assert s.get(DBColumn.BEACON_STATE, b"a" * 32) == b"state-bytes" * 1000
+    s.close()
+
+    s2 = NativeStore(db_path)  # WAL replay
+    assert s2.get(DBColumn.BEACON_BLOCK, b"a" * 32) == b"block-bytes"
+    s2.close()
+
+
+def test_get_prefix_partial_read(db_path):
+    s = NativeStore(db_path)
+    val = bytes(range(256)) * 10
+    s.put(DBColumn.BLOB_SIDECARS, b"r" * 32, val)
+    assert s.get_prefix(DBColumn.BLOB_SIDECARS, b"r" * 32, 8) == val[:8]
+    s.flush()  # now served from an SSTable pread
+    assert s.get_prefix(DBColumn.BLOB_SIDECARS, b"r" * 32, 8) == val[:8]
+    assert s.get_prefix(DBColumn.BLOB_SIDECARS, b"x" * 32, 8) is None
+    s.close()
+
+
+def test_delete_and_tombstone_shadowing(db_path):
+    s = NativeStore(db_path)
+    s.put(DBColumn.BEACON_BLOCK, b"k1", b"v1")
+    s.flush()  # v1 lives in an SSTable
+    s.delete(DBColumn.BEACON_BLOCK, b"k1")  # tombstone in memtable
+    assert s.get(DBColumn.BEACON_BLOCK, b"k1") is None
+    s.flush()  # tombstone now in a newer SSTable
+    assert s.get(DBColumn.BEACON_BLOCK, b"k1") is None
+    assert s.keys(DBColumn.BEACON_BLOCK) == []
+    s.compact()  # full merge drops the pair entirely
+    assert s.get(DBColumn.BEACON_BLOCK, b"k1") is None
+    assert s.stats()["sstables"] <= 1
+    s.close()
+
+
+def test_atomic_batch_and_keys(db_path):
+    s = NativeStore(db_path)
+    s.put(DBColumn.BEACON_BLOCK, b"gone", b"x")
+    s.do_atomically(
+        [
+            ("put", DBColumn.BEACON_BLOCK, b"k1", b"v1"),
+            ("put", DBColumn.BEACON_BLOCK, b"k2", b"v2"),
+            ("delete", DBColumn.BEACON_BLOCK, b"gone"),
+            ("put", DBColumn.BEACON_STATE, b"k1", b"sv"),
+        ]
+    )
+    assert sorted(s.keys(DBColumn.BEACON_BLOCK)) == [b"k1", b"k2"]
+    assert s.keys(DBColumn.BEACON_STATE) == [b"k1"]
+    s.close()
+
+
+def test_torn_wal_tail_drops_only_last_batch(db_path):
+    s = NativeStore(db_path)
+    s.put(DBColumn.BEACON_BLOCK, b"first", b"committed")
+    s.do_atomically(
+        [
+            ("put", DBColumn.BEACON_BLOCK, b"second", b"also-committed"),
+        ]
+    )
+    s.put(DBColumn.BEACON_BLOCK, b"third", b"torn")
+    # Simulate a crash that tore the last batch record: chop bytes off the
+    # WAL tail without closing cleanly (close() would flush to an SSTable).
+    wal = os.path.join(db_path, "wal.log")
+    size = os.path.getsize(wal)
+    with open(wal, "r+b") as f:
+        f.truncate(size - 3)
+    s.abandon()  # crash: no close-time flush
+
+    s2 = NativeStore(db_path)
+    assert s2.get(DBColumn.BEACON_BLOCK, b"first") == b"committed"
+    assert s2.get(DBColumn.BEACON_BLOCK, b"second") == b"also-committed"
+    assert s2.get(DBColumn.BEACON_BLOCK, b"third") is None  # torn → dropped
+    # The truncated tail must not poison subsequent appends.
+    s2.put(DBColumn.BEACON_BLOCK, b"fourth", b"post-crash")
+    s2.close()
+    s3 = NativeStore(db_path)
+    assert s3.get(DBColumn.BEACON_BLOCK, b"fourth") == b"post-crash"
+    s3.close()
+
+
+def test_corrupt_wal_crc_detected(db_path):
+    s = NativeStore(db_path)
+    s.put(DBColumn.BEACON_BLOCK, b"ok", b"v")
+    s.put(DBColumn.BEACON_BLOCK, b"bad", b"w")
+    wal = os.path.join(db_path, "wal.log")
+    data = open(wal, "rb").read()
+    # Flip a bit inside the SECOND record's payload (first record intact).
+    first_len = struct.unpack_from("<I", data, 4)[0]
+    off = 8 + first_len + 8 + 2  # into the second payload
+    data = data[:off] + bytes([data[off] ^ 0xFF]) + data[off + 1:]
+    with open(wal, "wb") as f:
+        f.write(data)
+    s.abandon()  # crash: no close-time flush
+
+    s2 = NativeStore(db_path)
+    assert s2.get(DBColumn.BEACON_BLOCK, b"ok") == b"v"
+    assert s2.get(DBColumn.BEACON_BLOCK, b"bad") is None
+    s2.close()
+
+
+def test_flush_compact_reopen_cycle(db_path):
+    s = NativeStore(db_path, mem_limit_bytes=1 << 14)  # tiny: force flushes
+    expect = {}
+    rng = random.Random(1234)
+    for i in range(400):
+        k = rng.randrange(64).to_bytes(8, "little")
+        v = rng.randbytes(rng.randrange(1, 2048))
+        expect[k] = v
+        s.put(DBColumn.BEACON_STATE, k, v)
+        if rng.random() < 0.1:
+            dk = rng.randrange(64).to_bytes(8, "little")
+            expect.pop(dk, None)
+            s.delete(DBColumn.BEACON_STATE, dk)
+    assert s.stats()["sstables"] >= 1  # the small limit really flushed
+    for k, v in expect.items():
+        assert s.get(DBColumn.BEACON_STATE, k) == v
+    assert sorted(s.keys(DBColumn.BEACON_STATE)) == sorted(expect)
+    s.compact()
+    assert sorted(s.keys(DBColumn.BEACON_STATE)) == sorted(expect)
+    s.close()
+
+    s2 = NativeStore(db_path)
+    for k, v in expect.items():
+        assert s2.get(DBColumn.BEACON_STATE, k) == v
+    s2.close()
+
+
+def test_model_check_vs_memory_store(db_path):
+    """Randomized ops applied to both engines must agree at every step."""
+    s = NativeStore(db_path, mem_limit_bytes=1 << 15)
+    model = MemoryStore()
+    rng = random.Random(99)
+    cols = [DBColumn.BEACON_BLOCK, DBColumn.BEACON_STATE, DBColumn.OP_POOL]
+    for step in range(300):
+        col = rng.choice(cols)
+        k = rng.randrange(48).to_bytes(4, "big")
+        roll = rng.random()
+        if roll < 0.55:
+            v = rng.randbytes(rng.randrange(0, 512))
+            s.put(col, k, v)
+            model.put(col, k, v)
+        elif roll < 0.75:
+            s.delete(col, k)
+            model.delete(col, k)
+        elif roll < 0.9:
+            ops = []
+            for _ in range(rng.randrange(1, 6)):
+                kk = rng.randrange(48).to_bytes(4, "big")
+                if rng.random() < 0.7:
+                    ops.append(("put", col, kk, rng.randbytes(32)))
+                else:
+                    ops.append(("delete", col, kk))
+            s.do_atomically(ops)
+            model.do_atomically(ops)
+        else:
+            s.flush() if rng.random() < 0.5 else s.compact()
+        probe = rng.randrange(48).to_bytes(4, "big")
+        assert s.get(col, probe) == model.get(col, probe), f"step {step}"
+    for col in cols:
+        assert sorted(s.keys(col)) == sorted(model.keys(col))
+    s.close()
+
+
+def test_hot_cold_db_on_native_store(tmp_path):
+    """HotColdDB round-trips a real BeaconState through the native engine."""
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.state_processing.genesis import interop_genesis_state
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec
+
+    old = bls.backend_name()
+    bls.set_backend("fake_crypto")
+    try:
+        spec = minimal_spec()
+        kps = bls.interop_keypairs(8)
+        state = interop_genesis_state(
+            kps, 1_600_000_000, b"\x42" * 32, spec, MinimalEthSpec
+        )
+        root = state.hash_tree_root()
+
+        from lighthouse_tpu.types.containers import build_types
+
+        store = HotColdDB(
+            open_item_store(str(tmp_path / "hot"), "native"),
+            open_item_store(str(tmp_path / "cold"), "native"),
+            types=build_types(MinimalEthSpec),
+        )
+        store.put_state(root, state)
+        got = store.get_state(root)
+        assert got is not None
+        assert got.hash_tree_root() == root
+    finally:
+        bls.set_backend(old)
+
+
+def test_open_item_store_auto_prefers_native(tmp_path):
+    s = open_item_store(str(tmp_path / "auto-db"))
+    assert isinstance(s, NativeStore)
+    s.close()
+
+
+def test_second_opener_refused_by_lock(db_path):
+    """LevelDB-style LOCK file: a second opener (e.g. the db CLI against a
+    running node) fails loudly instead of corrupting the live store."""
+    from lighthouse_tpu.store.native import NativeStoreError
+
+    s = NativeStore(db_path)
+    s.put(DBColumn.BEACON_BLOCK, b"k", b"v")
+    with pytest.raises(NativeStoreError, match="locked by another process"):
+        NativeStore(db_path)
+    s.close()
+    # released on close: reopen succeeds
+    s2 = NativeStore(db_path)
+    assert s2.get(DBColumn.BEACON_BLOCK, b"k") == b"v"
+    s2.close()
