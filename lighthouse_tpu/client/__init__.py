@@ -16,8 +16,7 @@ from ..beacon_chain.timer import SlotTimer
 from ..crypto import bls
 from ..metrics import set_gauge
 from ..state_processing import interop_genesis_state
-from ..store import HotColdDB, MemoryStore
-from ..store.kv import SqliteStore
+from ..store import HotColdDB, MemoryStore, open_item_store
 from ..utils.logging import get_logger
 from ..utils.slot_clock import ManualSlotClock, SystemTimeSlotClock
 from ..utils.task_executor import ShutdownSignal, TaskExecutor
@@ -30,8 +29,11 @@ class ClientConfig:
     spec: object = None
     E: object = None
     db_path: str | None = None  # None = MemoryStore
+    db_backend: str = "auto"  # auto | native (C++ LSM) | sqlite
     http_port: int | None = 0  # None = disabled
     network_port: int | None = 0  # None = disabled
+    noise: bool = False  # secure p2p streams with Noise XX
+    noise_seed: bytes | None = None  # deterministic identity (tests)
     validator_count: int = 16  # interop genesis size
     validate: bool = False  # run an in-process VC over the interop keys
     mock_execution_layer: bool = True
@@ -101,7 +103,7 @@ class ClientBuilder:
         c = self.client
         # store
         if cfg.db_path:
-            store = HotColdDB(SqliteStore(cfg.db_path))
+            store = HotColdDB(open_item_store(cfg.db_path, cfg.db_backend))
         else:
             store = HotColdDB(MemoryStore())
         # genesis
@@ -150,7 +152,19 @@ class ClientBuilder:
         if cfg.network_port is not None:
             from ..network import NetworkService
 
-            c.network = NetworkService(c.chain, port=cfg.network_port)
+            transport = None
+            if cfg.noise:
+                from ..network.noise import NoiseIdentity, NoiseTransport
+
+                identity = (
+                    NoiseIdentity.from_seed(cfg.noise_seed)
+                    if cfg.noise_seed is not None
+                    else NoiseIdentity()
+                )
+                transport = NoiseTransport(identity)
+            c.network = NetworkService(
+                c.chain, port=cfg.network_port, transport=transport
+            )
         # http (identity/peers routes read the network when present)
         if cfg.http_port is not None:
             from ..http_api import HttpApiServer

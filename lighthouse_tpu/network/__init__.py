@@ -4,10 +4,13 @@ The distributed half of the node (SURVEY §2.10). The reference's stack is
 libp2p (gossipsub + eth2 RPC + discv5) with noise/yamux transports; this
 implementation keeps the same protocol SURFACE — fork-digest gossip topics
 with spec message-ids, SSZ-snappy RPC methods, Status handshakes, peer
-scoring/banning, range sync — over plain TCP on the host network (ICI/DCN
-carry only device collectives; p2p always stays on the host CPU). The
-transport-security/muxing layers are the missing piece for mainnet wire
-compat and slot in below `rpc.py` without touching this layer.
+scoring/banning, range sync — on the host network (ICI/DCN carry only
+device collectives; p2p always stays on the host CPU). Transport security
+is the real libp2p Noise XX handshake (network/noise.py) when a
+NoiseTransport is supplied: streams are then encrypted and the peer's
+ed25519 identity is verified and used for identity-level bans. Remaining
+wire-compat gaps vs mainnet libp2p: multistream-select/yamux muxing and
+discv5 packet crypto (discovery here uses its own UDP record protocol).
 
 Components: `NetworkService` (service/mod.rs analog) owning the server +
 peer set, `GossipRouter` (vendored-gossipsub stand-in: flood publish with
@@ -57,6 +60,10 @@ class Peer:
     banned_at: float = 0.0
     gossip_sock: socket.socket | None = None
     lock: threading.Lock = field(default_factory=threading.Lock)
+    # Noise-authenticated libp2p-style identity (None on plain TCP).
+    # Bans recorded against this id survive address changes — a banned
+    # node redialing from a new port keeps its cryptographic identity.
+    noise_peer_id: str | None = None
 
     @property
     def peer_id(self) -> str:
@@ -66,6 +73,10 @@ class Peer:
 class PeerManager:
     def __init__(self):
         self._peers: dict[str, Peer] = {}
+        # noise identity -> ban timestamp: identity-level bans (used when
+        # the transport authenticates peers; address bans alone can be
+        # dodged by redialing from a fresh port)
+        self._banned_ids: dict[str, float] = {}
         self._lock = threading.Lock()
 
     def add(self, peer: Peer) -> bool:
@@ -76,6 +87,12 @@ class PeerManager:
         bad score back to 0) and releases the stale socket."""
         stale_sock = None
         with self._lock:
+            if peer.noise_peer_id is not None:
+                banned_at = self._banned_ids.get(peer.noise_peer_id)
+                if banned_at is not None:
+                    if time.monotonic() - banned_at < BAN_DURATION:
+                        return False
+                    self._banned_ids.pop(peer.noise_peer_id, None)
             existing = self._peers.get(peer.peer_id)
             if existing is not None:
                 if existing.banned:
@@ -143,6 +160,8 @@ class PeerManager:
             if p.score <= BAN_THRESHOLD and not p.banned:
                 p.banned = True
                 p.banned_at = time.monotonic()
+                if p.noise_peer_id is not None:
+                    self._banned_ids[p.noise_peer_id] = p.banned_at
                 newly_banned = p
                 inc_counter("network_peers_banned_total")
             n = self._gauge_count()
@@ -394,9 +413,14 @@ class NetworkService:
         host: str = "127.0.0.1",
         port: int = 0,
         bootnodes=None,
+        transport=None,
     ):
         self.chain = chain
         self.spec = chain.spec
+        # transport security seam: None = plain TCP; a NoiseTransport
+        # (network/noise.py) secures every stream with the libp2p Noise XX
+        # handshake, as the reference's transport builder does
+        self.transport = transport
         self.peers = PeerManager()
         self.gossip = GossipRouter(self)
         self.sync = SyncManager(self)
@@ -526,13 +550,21 @@ class NetworkService:
         persistent gossip stream."""
         if self.peers.is_banned(f"{host}:{port}"):
             raise RpcError("peer is banned")
-        client = RpcClient(host, port)
+        client = RpcClient(host, port, transport=self.transport)
         status = client.status(self.local_status())
         if bytes(status.fork_digest) != self.fork_digest():
             client.goodbye(M.GOODBYE_IRRELEVANT_NETWORK)
             raise RpcError("peer on a different fork digest")
         peer = Peer(host=host, port=port, client=client, status=status)
-        peer.gossip_sock = socket.create_connection((host, port), timeout=10)
+        gossip_sock = socket.create_connection((host, port), timeout=10)
+        if self.transport is not None:
+            try:
+                gossip_sock = self.transport.wrap_outbound(gossip_sock)
+            except Exception:
+                gossip_sock.close()
+                raise
+            peer.noise_peer_id = getattr(gossip_sock, "remote_peer_id", None)
+        peer.gossip_sock = gossip_sock
         # bounded I/O: a stalled remote must not wedge publish (sendall
         # holds peer.lock); the reader probes idle timeouts harmlessly
         peer.gossip_sock.settimeout(_GOSSIP_IO_TIMEOUT)
@@ -577,8 +609,9 @@ class NetworkService:
         peer = Peer(
             host=host,
             port=listen_port,
-            client=RpcClient(host, listen_port),
+            client=RpcClient(host, listen_port, transport=self.transport),
             gossip_sock=sock,
+            noise_peer_id=getattr(sock, "remote_peer_id", None),
         )
         if not self.peers.add(peer):
             try:

@@ -23,6 +23,7 @@ from . import messages as M
 RESP_SUCCESS = 0
 RESP_INVALID_REQUEST = 1
 RESP_SERVER_ERROR = 2
+RESP_RATE_LIMITED = 3  # p2p-interface ResourceUnavailable-class refusal
 
 MAX_PAYLOAD = 1 << 22  # 4 MiB cap (gossip_max_size class bound)
 MAX_REQUEST_BLOCKS = 1024
@@ -115,20 +116,35 @@ class RpcServer:
     """Serves the req/resp protocols for one beacon node; gossip streams
     are handed off to the network service's subscriber loop."""
 
-    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0,
+                 rate_limiter=None):
+        from .rate_limiter import RateLimiter
+
         self.node = node  # NetworkService
+        # per-peer, per-protocol token buckets (rpc/rate_limiter.rs)
+        self.rate_limiter = rate_limiter or RateLimiter()
 
         rpc = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
-                    proto = _recv_protocol(self.request)
+                    sock = self.request
+                    transport = getattr(rpc.node, "transport", None)
+                    if transport is not None:
+                        # bound the handshake; streams set their own
+                        # timeouts afterwards
+                        sock.settimeout(10.0)
+                        sock = transport.wrap_inbound(sock)
+                        sock.settimeout(None)
+                    proto = _recv_protocol(sock)
                     if proto == M.PROTO_GOSSIP:
-                        rpc.node._handle_gossip_stream(self.request)
+                        rpc.node._handle_gossip_stream(sock)
                         return
-                    rpc._handle_rpc(proto, self.request)
+                    rpc._handle_rpc(proto, sock)
                 except (RpcError, OSError):
+                    # NoiseError subclasses OSError: security failures
+                    # drop the stream like any dead connection
                     pass
 
         class Server(socketserver.ThreadingTCPServer):
@@ -151,18 +167,43 @@ class RpcServer:
 
     # -- request dispatch -------------------------------------------------------
 
+    def _peer_key(self, sock) -> str:
+        """Bucket key: the noise-authenticated identity when the stream is
+        secured, else the remote host (ports rotate per request stream)."""
+        noise_id = getattr(sock, "remote_peer_id", None)
+        if noise_id is not None:
+            return noise_id
+        try:
+            return sock.getpeername()[0]
+        except OSError:
+            return "?"
+
+    def _limited(self, sock, proto: str, cost: float) -> bool:
+        """True (and the refusal already sent) when over quota."""
+        if self.rate_limiter.allow(self._peer_key(sock), proto, cost):
+            return False
+        inc_counter("rpc_rate_limited_total", protocol=proto.split("/")[-3])
+        self._respond(sock, RESP_RATE_LIMITED, b"rate limited")
+        return True
+
     def _handle_rpc(self, proto: str, sock):
         inc_counter("rpc_requests_total", protocol=proto.split("/")[-3])
         node = self.node
         if proto == M.PROTO_STATUS:
             _req = M.StatusMessage.deserialize(_recv_block(sock))
+            if self._limited(sock, proto, 1):
+                return
             self._respond(sock, RESP_SUCCESS, node.local_status().serialize())
         elif proto == M.PROTO_PING:
             _req = M.Ping.deserialize(_recv_block(sock))
+            if self._limited(sock, proto, 1):
+                return
             self._respond(
                 sock, RESP_SUCCESS, M.Ping(data=node.metadata_seq).serialize()
             )
         elif proto == M.PROTO_METADATA:
+            if self._limited(sock, proto, 1):
+                return
             self._respond(
                 sock,
                 RESP_SUCCESS,
@@ -172,17 +213,24 @@ class RpcServer:
             )
         elif proto == M.PROTO_GOODBYE:
             _req = M.GoodbyeReason.deserialize(_recv_block(sock))
+            if self._limited(sock, proto, 1):
+                return
             self._respond(sock, RESP_SUCCESS, M.GoodbyeReason(reason=0).serialize())
         elif proto == M.PROTO_BLOCKS_BY_RANGE:
             req = M.BlocksByRangeRequest.deserialize(_recv_block(sock))
             if req.count > MAX_REQUEST_BLOCKS or req.step != 1:
                 self._respond(sock, RESP_INVALID_REQUEST, b"")
                 return
+            # cost = blocks requested (the reference prices by work asked)
+            if self._limited(sock, proto, int(req.count)):
+                return
             for signed in node.blocks_by_range(req.start_slot, req.count):
                 self._respond(sock, RESP_SUCCESS, signed.serialize())
             sock.shutdown(socket.SHUT_WR)
         elif proto == M.PROTO_BLOCKS_BY_ROOT:
             req = M.BlocksByRootRequest.deserialize(_recv_block(sock))
+            if self._limited(sock, proto, max(1, len(list(req.roots)))):
+                return
             for signed in node.blocks_by_root(list(req.roots)):
                 self._respond(sock, RESP_SUCCESS, signed.serialize())
             sock.shutdown(socket.SHUT_WR)
@@ -195,11 +243,15 @@ class RpcServer:
             if req.count * max_blobs > MAX_REQUEST_BLOB_SIDECARS:
                 self._respond(sock, RESP_INVALID_REQUEST, b"")
                 return
+            if self._limited(sock, proto, int(req.count) * max_blobs):
+                return
             for sc in node.blob_sidecars_by_range(req.start_slot, req.count):
                 self._respond(sock, RESP_SUCCESS, sc.serialize())
             sock.shutdown(socket.SHUT_WR)
         elif proto == M.PROTO_BLOBS_BY_ROOT:
             req = M.BlobsByRootRequest.deserialize(_recv_block(sock))
+            if self._limited(sock, proto, max(1, len(list(req.blob_ids)))):
+                return
             for sc in node.blob_sidecars_by_root(list(req.blob_ids)):
                 self._respond(sock, RESP_SUCCESS, sc.serialize())
             sock.shutdown(socket.SHUT_WR)
@@ -218,12 +270,20 @@ class RpcServer:
 class RpcClient:
     """One-shot request streams to a peer (rpc/outbound.rs analog)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 transport=None):
         self.addr = (host, port)
         self.timeout = timeout
+        self.transport = transport  # None = plain TCP
 
     def _open(self, proto: str):
         sock = socket.create_connection(self.addr, timeout=self.timeout)
+        if self.transport is not None:
+            try:
+                sock = self.transport.wrap_outbound(sock)
+            except Exception:
+                sock.close()
+                raise
         _send_protocol(sock, proto)
         return sock
 

@@ -1,0 +1,206 @@
+"""Noise transport security (network/noise.py).
+
+Unit level: the Noise XX handshake itself (key agreement, mutual ed25519
+identity authentication, AEAD framing, tamper detection, resumable frame
+reads). Integration level: two beacon nodes running the full gossip/RPC
+stack over NoiseTransport, plus a plaintext dialer being rejected.
+
+Reference match: lighthouse_network's transport builder secures every
+connection with libp2p-noise (Noise_XX_25519_ChaChaPoly_SHA256 with a
+signed identity payload)."""
+
+import socket
+import struct
+import threading
+import time
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.network import NetworkService
+from lighthouse_tpu.network.noise import (
+    NoiseError,
+    NoiseIdentity,
+    NoiseTransport,
+    peer_id_of_identity_pub,
+    secure_inbound,
+    secure_outbound,
+)
+from lighthouse_tpu.network.rpc import RpcClient, RpcError
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+
+def _pair(seed_a=b"a", seed_b=b"b"):
+    sa, sb = socket.socketpair()
+    ia = NoiseIdentity.from_seed(seed_a)
+    ib = NoiseIdentity.from_seed(seed_b)
+    out = {}
+
+    def responder():
+        try:
+            out["srv"] = secure_inbound(sb, ib)
+        except NoiseError as e:
+            out["err"] = e
+
+    t = threading.Thread(target=responder)
+    t.start()
+    client = secure_outbound(sa, ia)
+    t.join()
+    if "err" in out:
+        raise out["err"]
+    return client, out["srv"], ia, ib
+
+
+def test_handshake_mutual_authentication():
+    client, server, ia, ib = _pair()
+    assert client.remote_identity == ib.identity_pub_bytes()
+    assert server.remote_identity == ia.identity_pub_bytes()
+    assert client.remote_peer_id == ib.peer_id()
+    assert server.remote_peer_id == ia.peer_id()
+    # peer ids are identity multihashes over the protobuf pubkey
+    assert client.remote_peer_id.startswith("0024")
+
+
+def test_transport_round_trip_multi_frame():
+    client, server, _, _ = _pair()
+    big = b"0123456789abcdef" * 20000  # 320 KB: spans many 64KB frames
+    # send from a thread: the kernel socket buffer is smaller than the
+    # payload, so a synchronous sendall would deadlock against our read
+    sender = threading.Thread(target=client.sendall, args=(big,))
+    sender.start()
+    got = bytearray()
+    while len(got) < len(big):
+        chunk = server.recv(1 << 16)
+        assert chunk
+        got += chunk
+    assert bytes(got) == big
+    sender.join()
+    server.sendall(b"reply")
+    assert client.recv(1024) == b"reply"
+
+
+def test_bidirectional_interleaved():
+    client, server, _, _ = _pair()
+    for i in range(20):
+        msg = bytes([i]) * (i * 100 + 1)
+        client.sendall(msg)
+        assert server.recv(len(msg) + 10) == msg
+        server.sendall(msg)
+        assert client.recv(len(msg) + 10) == msg
+
+
+def test_tampered_ciphertext_rejected():
+    client, server, _, _ = _pair()
+    raw_client_side = client._sock  # underlying socket
+    # craft a frame with flipped ciphertext bits
+    ct = bytearray(client._send.encrypt(b"", b"attack payload"))
+    ct[0] ^= 0xFF
+    raw_client_side.sendall(struct.pack(">H", len(ct)) + bytes(ct))
+    with pytest.raises(NoiseError):
+        server.recv(1024)
+
+
+def test_wrong_identity_signature_rejected():
+    """A responder whose payload signs the WRONG static key must fail
+    the initiator's verification."""
+    sa, sb = socket.socketpair()
+    ia = NoiseIdentity.from_seed(b"good")
+    ib = NoiseIdentity.from_seed(b"evil")
+    # break ib's certification: swap its static key after the payload
+    # would have been built — easiest is to monkeypatch handshake_payload
+    # to sign a different static key
+    other = NoiseIdentity.from_seed(b"other")
+    ib.handshake_payload = other.handshake_payload  # type: ignore[method-assign]
+    errs = {}
+
+    def responder():
+        try:
+            secure_inbound(sb, ib)
+        except (NoiseError, OSError) as e:
+            errs["srv"] = e
+
+    t = threading.Thread(target=responder)
+    t.start()
+    with pytest.raises(NoiseError, match="identity signature"):
+        secure_outbound(sa, ia)
+    sa.close()
+    t.join(timeout=5)
+
+
+def test_recv_resumes_after_timeout_mid_frame():
+    """A read timeout mid-frame must not desynchronize the stream (the
+    gossip reader probes with short timeouts and retries)."""
+    sa, sb = socket.socketpair()
+    ia, ib = NoiseIdentity.from_seed(b"x"), NoiseIdentity.from_seed(b"y")
+    out = {}
+    t = threading.Thread(target=lambda: out.update(s=secure_inbound(sb, ib)))
+    t.start()
+    client = secure_outbound(sa, ia)
+    t.join()
+    server = out["s"]
+
+    payload = b"slow delivery test"
+    frame = client._send.encrypt(b"", payload)
+    wire = struct.pack(">H", len(frame)) + frame
+    # dribble the first 7 bytes, let the server time out, then finish
+    sa.sendall(wire[:7])
+    server.settimeout(0.2)
+    for _ in range(3):
+        try:
+            got = server.recv(1024)
+            break
+        except TimeoutError:
+            sa.sendall(wire[7:])  # finish the frame, then retry
+    assert got == payload
+
+
+def _harness(slots=0):
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, E, validator_count=16)
+    if slots:
+        h.extend_chain(slots)
+    return h
+
+
+def test_two_nodes_full_stack_over_noise():
+    """Gossip + RPC + range sync between two nodes, every stream secured
+    with Noise XX."""
+    a = _harness(slots=E.SLOTS_PER_EPOCH)
+    b = _harness()
+    na = NetworkService(a.chain, transport=NoiseTransport()).start()
+    nb = NetworkService(b.chain, transport=NoiseTransport()).start()
+    try:
+        # RPC over noise (client must use the node's transport)
+        client = RpcClient("127.0.0.1", na.port, transport=nb.transport)
+        status = client.status(nb.local_status())
+        assert int(status.head_slot) == a.chain.head_state.slot
+
+        # plaintext dialer is refused by a noise listener
+        plain = RpcClient("127.0.0.1", na.port, timeout=2.0)
+        with pytest.raises((RpcError, OSError)):
+            plain.status(nb.local_status())
+
+        # peering + range sync over noise
+        b.slot_clock.set_slot(a.chain.head_state.slot)
+        peer = nb.connect("127.0.0.1", na.port)
+        nb.sync.sync_with(peer)
+        assert b.chain.head_root == a.chain.head_root
+        time.sleep(0.2)  # let A's inbound-peer registration settle
+
+        # gossip over noise: a fresh block produced on A reaches B
+        slot = a.chain.head_state.slot + 1
+        a.slot_clock.set_slot(slot)
+        b.slot_clock.set_slot(slot)
+        root, signed = a.add_block_at_slot(slot)
+        na.publish_block(signed)
+        deadline = time.time() + 10
+        while time.time() < deadline and b.chain.head_root != root:
+            time.sleep(0.05)
+        assert b.chain.head_root == root
+    finally:
+        na.stop()
+        nb.stop()

@@ -1,0 +1,104 @@
+"""RPC rate limiting (network/rate_limiter.py + RpcServer wiring).
+
+Token-bucket semantics under a fake clock, cost-priced bulk protocols,
+and the server answering RESP_RATE_LIMITED over a live socket
+(rpc/rate_limiter.rs behavior)."""
+
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.network import NetworkService
+from lighthouse_tpu.network import messages as M
+from lighthouse_tpu.network.rate_limiter import Quota, RateLimiter
+from lighthouse_tpu.network.rpc import RpcClient, RpcError
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_bucket_deducts_and_replenishes():
+    clock = FakeClock()
+    rl = RateLimiter({"p": Quota(10, 10.0)}, clock=clock)  # 1 token/s
+    assert rl.allow("peer", "p", 10)  # drain fully
+    assert not rl.allow("peer", "p", 1)  # empty
+    clock.t += 3.0
+    assert rl.allow("peer", "p", 3)  # 3 tokens refilled
+    assert not rl.allow("peer", "p", 1)
+    clock.t += 100.0
+    assert rl.allow("peer", "p", 10)  # capped at max_tokens
+    assert not rl.allow("peer", "p", 1)
+
+
+def test_oversized_cost_always_refused_but_bucket_unharmed():
+    clock = FakeClock()
+    rl = RateLimiter({"p": Quota(5, 5.0)}, clock=clock)
+    assert not rl.allow("peer", "p", 6)  # can never be served
+    assert rl.allow("peer", "p", 5)  # the refusal spent nothing
+
+
+def test_buckets_are_per_peer_and_per_protocol():
+    clock = FakeClock()
+    rl = RateLimiter({"a": Quota(1, 10.0), "b": Quota(1, 10.0)}, clock=clock)
+    assert rl.allow("x", "a")
+    assert not rl.allow("x", "a")
+    assert rl.allow("x", "b")  # different protocol
+    assert rl.allow("y", "a")  # different peer
+    assert rl.allow("x", "unknown-protocol", cost=1e9)  # no quota = no limit
+
+
+def test_idle_buckets_pruned():
+    clock = FakeClock()
+    rl = RateLimiter({"p": Quota(4, 1.0)}, clock=clock)
+    for i in range(600):
+        rl.allow(f"peer{i}", "p")
+    clock.t += 60.0  # all idle far past 2× replenish
+    for i in range(600):  # trigger the amortized prune threshold
+        rl.allow(f"late{i}", "p")
+    assert len(rl._buckets) <= 700  # stale peers evicted, not accumulated
+
+
+def test_server_sends_rate_limited_response():
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, E, validator_count=16)
+    h.extend_chain(4)
+    na = NetworkService(h.chain).start()
+    try:
+        # throttle hard: 2 status requests per minute
+        na.server.rate_limiter = RateLimiter(
+            {M.PROTO_STATUS: Quota(2, 60.0)}
+        )
+        client = RpcClient("127.0.0.1", na.port)
+        local = M.StatusMessage(
+            fork_digest=na.fork_digest(),
+            finalized_root=b"\x00" * 32,
+            finalized_epoch=0,
+            head_root=h.chain.head_root,
+            head_slot=h.chain.head_state.slot,
+        )
+        client.status(local)
+        client.status(local)
+        with pytest.raises(RpcError, match="error response 3"):
+            client.status(local)
+        # bulk pricing: a by-range request for more blocks than the quota
+        # allows is refused even on first contact
+        na.server.rate_limiter = RateLimiter(
+            {M.PROTO_BLOCKS_BY_RANGE: Quota(4, 60.0)}
+        )
+        with pytest.raises(RpcError, match="chunk error 3"):
+            client.blocks_by_range(0, 8, na.decode_block)
+        # within quota works
+        blocks = client.blocks_by_range(1, 3, na.decode_block)
+        assert blocks
+    finally:
+        na.stop()
