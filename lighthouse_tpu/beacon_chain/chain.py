@@ -977,7 +977,7 @@ class BeaconChain:
                     )
                 )
         if fork >= ForkName.BELLATRIX:
-            payload = self._produce_payload(state, fork, tf)
+            payload = self._produce_payload(state, fork, tf, parent_root)
             body_kwargs["execution_payload"] = payload
         block = tf.BeaconBlock(
             slot=slot,
@@ -1001,7 +1001,7 @@ class BeaconChain:
         block.state_root = post.hash_tree_root()
         return block, post
 
-    def _produce_payload(self, state, fork, tf):
+    def _produce_payload(self, state, fork, tf, parent_beacon_block_root=None):
         """Execution payload for block production (beacon_chain.rs get
         execution payload → execution_layer get_payload, lib.rs:807).
 
@@ -1046,6 +1046,9 @@ class BeaconChain:
             suggested_fee_recipient=self.proposer_preparations.get(
                 get_beacon_proposer_index(state, self.E), b"\x00" * 20
             ),
+            # EIP-4788: Deneb+ execution headers commit to the parent
+            # beacon block root, so the builder needs it for the hash
+            parent_beacon_block_root=parent_beacon_block_root,
         )
         # Post-merge (and Capella+, whose spec asserts the parent link
         # unconditionally): build exactly on the state's execution header.

@@ -142,7 +142,9 @@ class MockExecutionLayer(ExecutionLayer):
             kwargs["blob_gas_used"] = 0
             kwargs["excess_blob_gas"] = 0
         payload = payload_cls(**kwargs)
-        block_hash = self._compute_block_hash(payload)
+        block_hash = self._compute_block_hash(
+            payload, attributes.parent_beacon_block_root
+        )
         payload.block_hash = block_hash
         self._known_payload_hashes.add(block_hash)
         self.generator.insert_pos_block(
@@ -150,18 +152,33 @@ class MockExecutionLayer(ExecutionLayer):
         )
         return payload
 
-    def _compute_block_hash(self, payload) -> bytes:
-        """Mock block hash: hash_tree_root of the payload with block_hash
-        zeroed (reference mock computes its own hash too)."""
-        return payload.hash_tree_root()
+    def _compute_block_hash(self, payload, parent_beacon_block_root) -> bytes:
+        """REAL execution block hash: keccak-256 of the RLP header
+        reconstructed from the payload (block_hash.rs) — the mock produces
+        hashes any mainnet-faithful verifier accepts."""
+        from .block_hash import calculate_execution_block_hash
+
+        block_hash, _ = calculate_execution_block_hash(
+            payload, parent_beacon_block_root
+        )
+        return block_hash
 
     # -- engine API ----------------------------------------------------------
 
     def notify_new_payload(self, request) -> PayloadStatusV1:
+        from .block_hash import verify_payload_block_hash
+
         if self.state is EngineState.OFFLINE:
             return PayloadStatusV1.SYNCING
         payload = request.execution_payload
         h = bytes(payload.block_hash)
+        # real keccak block-hash verification (block_hash.rs): a payload
+        # whose claimed hash does not match its RLP header is INVALID
+        # regardless of where it came from
+        if not verify_payload_block_hash(
+            payload, getattr(request, "parent_beacon_block_root", None)
+        ):
+            return PayloadStatusV1.INVALID
         if h in self._known_payload_hashes:
             return PayloadStatusV1.VALID
         # accept externally-produced payloads that hash-link correctly

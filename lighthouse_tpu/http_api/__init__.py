@@ -316,11 +316,13 @@ class BeaconApi:
             raise ApiError(404, "no state for that block root")
         if getattr(state, "current_sync_committee", None) is None:
             raise ApiError(404, "pre-Altair state has no light-client data")
-        return create_bootstrap(state, chain.E).serialize()
+        fork = chain.types.fork_of_state(state)
+        return create_bootstrap(state, chain.E).serialize(), fork.value
 
-    def light_client_update_ssz(self) -> bytes:
+    def light_client_update_ssz(self) -> tuple[bytes, str]:
         """GET /eth/v1/beacon/light_client/update (SSZ): the latest
-        update — the head block's sync aggregate attesting its parent."""
+        update — the head block's sync aggregate attesting its parent.
+        Returns (ssz_bytes, consensus_version)."""
         from ..light_client import create_update
 
         chain = self.chain
@@ -347,7 +349,8 @@ class BeaconApi:
             int(head_block.message.slot),
             chain.E,
         )
-        return update.serialize()
+        fork = chain.types.fork_of_state(attested_state)
+        return update.serialize(), fork.value
 
     def get_aggregate_ssz(self, slot: int, data_root: bytes) -> bytes:
         """GET /eth/v1/validator/aggregate_attestation (SSZ body)."""
@@ -757,9 +760,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_bytes(self, data: bytes, code=200):
+    def _send_bytes(self, data: bytes, code=200, version: str | None = None):
         self.send_response(code)
         self.send_header("Content-Type", "application/octet-stream")
+        if version is not None:
+            # beacon-API consensus-version header: SSZ consumers need the
+            # fork to pick the right container family (e.g. Electra's
+            # deeper light-client branches)
+            self.send_header("Eth-Consensus-Version", version)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -821,12 +829,14 @@ class _Handler(BaseHTTPRequestHandler):
                 path,
             )
             if m:
-                self._send_bytes(
-                    self.api.light_client_bootstrap_ssz(m.group("root"))
+                data, version = self.api.light_client_bootstrap_ssz(
+                    m.group("root")
                 )
+                self._send_bytes(data, version=version)
                 return
             if path == "/eth/v1/beacon/light_client/update":
-                self._send_bytes(self.api.light_client_update_ssz())
+                data, version = self.api.light_client_update_ssz()
+                self._send_bytes(data, version=version)
                 return
             if path == "/eth/v1/validator/aggregate_attestation":
                 q = parse_qs(parsed.query)

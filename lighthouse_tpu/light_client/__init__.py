@@ -13,6 +13,7 @@ spec — sync-committee signature check included."""
 # and the light-client containers are built inside a function (their field
 # types must stay live objects).
 
+import functools
 from dataclasses import dataclass
 
 from ..crypto import bls
@@ -27,11 +28,27 @@ from ..state_processing.accessors import (
 )
 from ..types.chain_spec import Domain, compute_signing_root
 
-# branch depths: altair+ BeaconState has ≤32 fields → depth 5; the
-# finalized root adds Checkpoint.root (field 1 of 2 → depth 3 over the
-# padded 2-field container? no — checkpoint has 2 fields → depth 1)
+# branch depths: altair..deneb BeaconState has ≤32 fields → field-tree
+# depth 5; Electra widens the state past 32 fields (37 here) → depth 6
+# (the spec's *_GINDEX_ELECTRA revisions). The finality branch adds one
+# level for Checkpoint.root (2-field container → depth 1).
 NEXT_SYNC_COMMITTEE_DEPTH = 5
 FINALITY_DEPTH = 6  # state field (5) + checkpoint.root (1)
+NEXT_SYNC_COMMITTEE_DEPTH_ELECTRA = 6
+FINALITY_DEPTH_ELECTRA = 7
+
+
+def _state_depth(state_cls) -> int:
+    """Field-tree depth of a state class (5 for ≤32 fields, 6 to 64)."""
+    n = len(state_cls._fields)
+    if n <= 32:
+        return NEXT_SYNC_COMMITTEE_DEPTH
+    if n <= 64:
+        return NEXT_SYNC_COMMITTEE_DEPTH_ELECTRA
+    raise LightClientError(
+        f"{state_cls.__name__} has {n} fields; light-client branches cover "
+        "up to 64-field states"
+    )
 
 MIN_SYNC_COMMITTEE_PARTICIPANTS = 1
 
@@ -40,10 +57,22 @@ class LightClientError(ValueError):
     pass
 
 
-def build_light_client_types(E):
+def build_light_client_types(E, electra: bool = False):
+    """Light-client container family for preset `E`. Electra's widened
+    state deepens the branch vectors (the spec ships distinct Electra
+    light-client structures with *_GINDEX_ELECTRA depths)."""
+    return _build_light_client_types_cached(E, bool(electra))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_light_client_types_cached(E, electra: bool):
     from ..types.containers import build_types
 
     t = build_types(E)
+    sc_depth = (
+        NEXT_SYNC_COMMITTEE_DEPTH_ELECTRA if electra else NEXT_SYNC_COMMITTEE_DEPTH
+    )
+    fin_depth = FINALITY_DEPTH_ELECTRA if electra else FINALITY_DEPTH
 
     class LightClientHeader(Container):
         beacon: t.BeaconBlockHeader
@@ -51,14 +80,14 @@ def build_light_client_types(E):
     class LightClientBootstrap(Container):
         header: LightClientHeader
         current_sync_committee: t.SyncCommittee
-        current_sync_committee_branch: Vector[Bytes32, NEXT_SYNC_COMMITTEE_DEPTH]
+        current_sync_committee_branch: Vector[Bytes32, sc_depth]
 
     class LightClientUpdate(Container):
         attested_header: LightClientHeader
         next_sync_committee: t.SyncCommittee
-        next_sync_committee_branch: Vector[Bytes32, NEXT_SYNC_COMMITTEE_DEPTH]
+        next_sync_committee_branch: Vector[Bytes32, sc_depth]
         finalized_header: LightClientHeader
-        finality_branch: Vector[Bytes32, FINALITY_DEPTH]
+        finality_branch: Vector[Bytes32, fin_depth]
         sync_aggregate: t.SyncAggregate
         signature_slot: uint64
 
@@ -69,6 +98,9 @@ def build_light_client_types(E):
         LightClientBootstrap=LightClientBootstrap,
         LightClientUpdate=LightClientUpdate,
         base=t,
+        sc_depth=sc_depth,
+        fin_depth=fin_depth,
+        electra=electra,
     )
 
 
@@ -79,18 +111,11 @@ def build_light_client_types(E):
 
 def _state_field_branch(state, field_name: str) -> list[bytes]:
     cls = type(state)
+    depth = _state_depth(cls)  # 5 altair..deneb, 6 electra (spec gindices)
     fields = list(cls._fields.items())
-    if len(fields) > (1 << NEXT_SYNC_COMMITTEE_DEPTH):
-        # Electra widens the state past 32 fields → deeper gindices (the
-        # spec revises light-client branches there); this server produces
-        # altair..deneb updates
-        raise LightClientError(
-            f"{cls.__name__} has {len(fields)} fields; altair-depth light "
-            "client branches only cover ≤32-field states"
-        )
     chunks = [ft.hash_tree_root_of(getattr(state, f)) for f, ft in fields]
     index = [f for f, _ in fields].index(field_name)
-    return compute_merkle_proof(chunks, index, limit=1 << NEXT_SYNC_COMMITTEE_DEPTH)
+    return compute_merkle_proof(chunks, index, limit=1 << depth)
 
 
 def _block_header_of(state, lt):
@@ -109,8 +134,10 @@ def _block_header_of(state, lt):
 
 def create_bootstrap(state, E):
     """LightClientBootstrap anchored at `state` (served for a finalized
-    checkpoint root)."""
-    lt = build_light_client_types(E)
+    checkpoint root). Electra states get the deeper-branch family."""
+    lt = build_light_client_types(
+        E, electra=_state_depth(type(state)) > NEXT_SYNC_COMMITTEE_DEPTH
+    )
     return lt.LightClientBootstrap(
         header=_block_header_of(state, lt),
         current_sync_committee=state.current_sync_committee,
@@ -123,9 +150,12 @@ def create_bootstrap(state, E):
 def create_update(attested_state, finalized_state, sync_aggregate, signature_slot, E):
     """LightClientUpdate proving next_sync_committee + finality from the
     attested state, signed by `sync_aggregate` at `signature_slot`."""
-    lt = build_light_client_types(E)
+    lt = build_light_client_types(
+        E,
+        electra=_state_depth(type(attested_state)) > NEXT_SYNC_COMMITTEE_DEPTH,
+    )
     # finality branch: checkpoint.root within the state tree (shared helper
-    # keeps the >32-field guard and the single chunk computation)
+    # picks the fork's depth and computes the chunks once)
     state_branch = _state_field_branch(attested_state, "finalized_checkpoint")
     cp = attested_state.finalized_checkpoint
     # within Checkpoint (2 fields): root is index 1; sibling = epoch chunk
@@ -173,11 +203,13 @@ def initialize_light_client_store(trusted_block_root: bytes, bootstrap, E):
     sc_root = type(bootstrap.current_sync_committee).hash_tree_root_of(
         bootstrap.current_sync_committee
     )
-    # NOTE: verified against the header's STATE root via the field branch
+    # NOTE: verified against the header's STATE root via the field branch.
+    # The branch's own length carries the fork's depth (5 altair..deneb,
+    # 6 electra — field indices are stable because Electra appends fields).
     ok = verify_merkle_proof(
         sc_root,
         list(bootstrap.current_sync_committee_branch),
-        NEXT_SYNC_COMMITTEE_DEPTH,
+        len(bootstrap.current_sync_committee_branch),
         _bootstrap_sc_index(bootstrap, E),
         bytes(bootstrap.header.beacon.state_root),
     )
@@ -221,12 +253,13 @@ def process_light_client_update(
     fin_field_index = list(t.BeaconStateAltair._fields).index(
         "finalized_checkpoint"
     )
-    # gindex: checkpoint.root (bit 0 = 1) then the field path
+    # gindex: checkpoint.root (bit 0 = 1) then the field path; depth from
+    # the branch length (6 altair..deneb, 7 electra)
     index = 1 | (fin_field_index << 1)
     if not verify_merkle_proof(
         fin_root,
         list(update.finality_branch),
-        FINALITY_DEPTH,
+        len(update.finality_branch),
         index,
         bytes(att.state_root),
     ):
@@ -240,7 +273,7 @@ def process_light_client_update(
     if not verify_merkle_proof(
         sc_root,
         list(update.next_sync_committee_branch),
-        NEXT_SYNC_COMMITTEE_DEPTH,
+        len(update.next_sync_committee_branch),
         nsc_index,
         bytes(att.state_root),
     ):

@@ -91,13 +91,17 @@ def process_execution_payload(
 
     engine = engine if engine is not None else DEFAULT_ENGINE
     versioned_hashes = None
+    parent_beacon_block_root = None
     if fork >= ForkName.DENEB:
         versioned_hashes = [
             kzg_commitment_to_versioned_hash(c)
             for c in body.blob_kzg_commitments
         ]
+        # EIP-4788 / engine_newPayloadV3: the being-processed block's
+        # parent root (latest_block_header was set by process_block_header)
+        parent_beacon_block_root = bytes(state.latest_block_header.parent_root)
     if not engine.verify_and_notify_new_payload(
-        NewPayloadRequest(payload, versioned_hashes)
+        NewPayloadRequest(payload, versioned_hashes, parent_beacon_block_root)
     ):
         raise BlockProcessingError("payload: execution engine rejected payload")
 

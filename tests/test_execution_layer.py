@@ -47,8 +47,18 @@ def test_mock_el_payload_roundtrip():
     assert el.notify_forkchoice_updated(st, None) is PayloadStatusV1.VALID
     assert el.generator.head_hash == bytes(p2.block_hash)
 
-    # unknown-parent payload → SYNCING (not VALID)
-    orphan = type(p2)(parent_hash=b"\x77" * 32, block_hash=b"\x88" * 32)
+    # a payload whose claimed hash does not match its RLP header → INVALID
+    # (real keccak verification, block_hash.rs behavior)
+    fake = type(p2)(parent_hash=b"\x77" * 32, block_hash=b"\x88" * 32)
+    assert el.notify_new_payload(NewPayloadRequest(fake)) is PayloadStatusV1.INVALID
+
+    # correctly-hashed payload on an UNKNOWN parent → SYNCING (not VALID)
+    from lighthouse_tpu.execution_layer.block_hash import (
+        calculate_execution_block_hash,
+    )
+
+    orphan = type(p2)(parent_hash=b"\x77" * 32)
+    orphan.block_hash, _ = calculate_execution_block_hash(orphan)
     assert el.notify_new_payload(NewPayloadRequest(orphan)) is PayloadStatusV1.SYNCING
 
 

@@ -255,16 +255,23 @@ def test_light_client_http_routes():
             base = f"http://127.0.0.1:{srv.port}"
             fin_root = bytes(h.chain.finalized_checkpoint.root)
             assert fin_root != b"\x00" * 32
-            raw = urllib.request.urlopen(
+            resp = urllib.request.urlopen(
                 f"{base}/eth/v1/beacon/light_client/bootstrap/0x{fin_root.hex()}",
                 timeout=10,
-            ).read()
-            lt = build_light_client_types(E)
+            )
+            raw = resp.read()
+            # the consensus-version header selects the container family
+            # (Electra's branches are deeper)
+            version = resp.headers.get("Eth-Consensus-Version")
+            assert version == "altair"
+            lt = build_light_client_types(E, electra=version == "electra")
             boot = lt.LightClientBootstrap.deserialize(raw)
             store = initialize_light_client_store(fin_root, boot, E)
-            raw = urllib.request.urlopen(
+            resp = urllib.request.urlopen(
                 f"{base}/eth/v1/beacon/light_client/update", timeout=10
-            ).read()
+            )
+            raw = resp.read()
+            assert resp.headers.get("Eth-Consensus-Version") == "altair"
             update = lt.LightClientUpdate.deserialize(raw)
             process_light_client_update(
                 store,
@@ -279,3 +286,86 @@ def test_light_client_http_routes():
             srv.stop()
     finally:
         bls.set_backend("fake_crypto")
+
+
+def test_electra_deep_branches_round_trip():
+    """Electra's 37-field state gets depth-6 sync-committee branches and a
+    depth-7 finality branch (the spec's *_GINDEX_ELECTRA revision); the
+    client verifies them against the attested state root."""
+    from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+    from lighthouse_tpu.light_client import (
+        LightClientStore,
+        build_light_client_types,
+    )
+
+    spec = replace(
+        minimal_spec(),
+        altair_fork_epoch=0,
+        bellatrix_fork_epoch=0,
+        capella_fork_epoch=0,
+        deneb_fork_epoch=0,
+        electra_fork_epoch=0,
+    )
+    h = BeaconChainHarness(spec, E, validator_count=8, mock_execution_layer=True)
+    h.extend_chain(3 * E.SLOTS_PER_EPOCH)
+    assert type(h.chain.head_state).__name__ == "BeaconStateElectra"
+
+    fin_root = bytes(h.chain.finalized_checkpoint.root)
+    assert fin_root != b"\x00" * 32
+    boot_state = h.chain.state_for_block_root(fin_root)
+    boot = create_bootstrap(boot_state, E)
+    assert len(boot.current_sync_committee_branch) == 6
+    store = initialize_light_client_store(
+        boot.header.beacon.hash_tree_root(), boot, E
+    )
+
+    head_block = h.chain.head_block()
+    agg = head_block.message.body.sync_aggregate
+    attested_root = bytes(head_block.message.parent_root)
+    attested_state = h.chain.state_for_block_root(attested_root)
+    cp_root = bytes(attested_state.finalized_checkpoint.root)
+    fin_state = h.chain.state_for_block_root(cp_root)
+    update = create_update(
+        attested_state, fin_state, agg,
+        signature_slot=int(head_block.message.slot), E=E,
+    )
+    assert len(update.next_sync_committee_branch) == 6
+    assert len(update.finality_branch) == 7
+
+    # SSZ round-trip through the Electra container family (what the HTTP
+    # route ships with Eth-Consensus-Version: electra)
+    lt = build_light_client_types(E, electra=True)
+    update = lt.LightClientUpdate.deserialize(update.serialize())
+
+    process_light_client_update(
+        store, update,
+        current_slot=int(h.chain.head_state.slot) + 1,
+        spec=spec, E=E,
+        genesis_validators_root=bytes(h.chain.genesis_validators_root),
+    )
+    assert store.finalized_header.beacon.slot >= boot.header.beacon.slot
+
+    # a tampered deep branch must NOT verify (the extra level is part of
+    # the proof, not padding)
+    bad_branch = list(update.next_sync_committee_branch)
+    bad_branch[5] = b"\x66" * 32  # the Electra-only level
+    bad = lt.LightClientUpdate(
+        attested_header=update.attested_header,
+        next_sync_committee=update.next_sync_committee,
+        next_sync_committee_branch=bad_branch,
+        finalized_header=update.finalized_header,
+        finality_branch=list(update.finality_branch),
+        sync_aggregate=update.sync_aggregate,
+        signature_slot=update.signature_slot,
+    )
+    with pytest.raises(LightClientError):
+        process_light_client_update(
+            LightClientStore(
+                finalized_header=boot.header,
+                current_sync_committee=boot.current_sync_committee,
+            ),
+            bad,
+            current_slot=int(h.chain.head_state.slot) + 1,
+            spec=spec, E=E,
+            genesis_validators_root=bytes(h.chain.genesis_validators_root),
+        )
