@@ -172,11 +172,18 @@ class ClientBuilder:
             c.http_server = HttpApiServer(
                 c.chain, port=cfg.http_port, network=c.network
             )
-        # validator client
+        # validator client (publishes over gossip when the node networks)
         if cfg.validate:
-            from ..validator_client import ValidatorClient
+            from ..validator_client import GossipingBeaconNode, ValidatorClient
 
-            c.vc = ValidatorClient(c.chain, c.keypairs, cfg.spec, cfg.E)
+            node = (
+                GossipingBeaconNode(c.chain, c.network)
+                if c.network is not None
+                else None
+            )
+            c.vc = ValidatorClient(
+                c.chain, c.keypairs, cfg.spec, cfg.E, node=node
+            )
         # slasher (slasher/service feeds off the chain's verified objects)
         if cfg.slasher:
             from ..slasher.service import SlasherService

@@ -550,27 +550,33 @@ class NetworkService:
         persistent gossip stream."""
         if self.peers.is_banned(f"{host}:{port}"):
             raise RpcError("peer is banned")
-        client = RpcClient(host, port, transport=self.transport)
-        status = client.status(self.local_status())
-        if bytes(status.fork_digest) != self.fork_digest():
-            client.goodbye(M.GOODBYE_IRRELEVANT_NETWORK)
-            raise RpcError("peer on a different fork digest")
+        client = RpcClient(host, port, transport=self.transport, mux=True)
+        try:
+            status = client.status(self.local_status())
+            if bytes(status.fork_digest) != self.fork_digest():
+                client.goodbye(M.GOODBYE_IRRELEVANT_NETWORK)
+                raise RpcError("peer on a different fork digest")
+        except BaseException:
+            # the muxed connection (+ reader thread) must not outlive a
+            # failed dial
+            client.close()
+            raise
         peer = Peer(host=host, port=port, client=client, status=status)
-        gossip_sock = socket.create_connection((host, port), timeout=10)
-        if self.transport is not None:
-            try:
-                gossip_sock = self.transport.wrap_outbound(gossip_sock)
-            except Exception:
-                gossip_sock.close()
-                raise
+        try:
+            # the gossip stream rides the SAME muxed connection as the RPC
+            # substreams — one TCP (+ one noise handshake) per direction
+            gossip_sock = client._open(M.PROTO_GOSSIP)
             peer.noise_peer_id = getattr(gossip_sock, "remote_peer_id", None)
-        peer.gossip_sock = gossip_sock
-        # bounded I/O: a stalled remote must not wedge publish (sendall
-        # holds peer.lock); the reader probes idle timeouts harmlessly
-        peer.gossip_sock.settimeout(_GOSSIP_IO_TIMEOUT)
-        _send_protocol(peer.gossip_sock, M.PROTO_GOSSIP)
-        # announce our listening port so the peer can identify us
-        _send_block(peer.gossip_sock, self.port.to_bytes(4, "little"))
+            peer.gossip_sock = gossip_sock
+            # bounded I/O: a stalled remote must not wedge publish (sendall
+            # holds peer.lock); the reader probes idle timeouts harmlessly
+            peer.gossip_sock.settimeout(_GOSSIP_IO_TIMEOUT)
+            # announce our listening port so the peer can identify us
+            # (_open already negotiated the gossip protocol on the stream)
+            _send_block(peer.gossip_sock, self.port.to_bytes(4, "little"))
+        except BaseException:
+            client.close()
+            raise
         if not self.peers.add(peer):
             # refusal cleanup must not mask the refusal: close/goodbye are
             # best-effort against a peer that may already be gone
@@ -579,6 +585,7 @@ class NetworkService:
                 client.goodbye(M.GOODBYE_BANNED)
             except (OSError, RpcError):
                 pass
+            client.close()
             raise RpcError("peer is banned")
         t = threading.Thread(
             target=self._gossip_reader,
@@ -597,6 +604,10 @@ class NetworkService:
                 except OSError:
                     pass
                 peer.gossip_sock = None
+        try:
+            peer.client.close()  # tear down the muxed RPC connection
+        except OSError:
+            pass
         self.peers.remove(peer.peer_id)
 
     # -- gossip plumbing --------------------------------------------------------
@@ -609,7 +620,9 @@ class NetworkService:
         peer = Peer(
             host=host,
             port=listen_port,
-            client=RpcClient(host, listen_port, transport=self.transport),
+            client=RpcClient(
+                host, listen_port, transport=self.transport, mux=True
+            ),
             gossip_sock=sock,
             noise_peer_id=getattr(sock, "remote_peer_id", None),
         )

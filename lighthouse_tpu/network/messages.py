@@ -94,6 +94,7 @@ PROTO_BLOBS_BY_RANGE = (
 )
 PROTO_BLOBS_BY_ROOT = "/eth2/beacon_chain/req/blob_sidecars_by_root/1/ssz_snappy"
 PROTO_GOSSIP = "/lighthouse_tpu/gossip/1"  # persistent pub/sub stream
+PROTO_MUX = "/lighthouse_tpu/mux/1"  # yamux-style multiplexed connection
 
 TOPIC_BEACON_BLOCK = "beacon_block"
 ATTESTATION_SUBNET_COUNT = 64

@@ -138,10 +138,10 @@ class RpcServer:
                         sock = transport.wrap_inbound(sock)
                         sock.settimeout(None)
                     proto = _recv_protocol(sock)
-                    if proto == M.PROTO_GOSSIP:
-                        rpc.node._handle_gossip_stream(sock)
+                    if proto == M.PROTO_MUX:
+                        rpc._serve_mux(sock)
                         return
-                    rpc._handle_rpc(proto, sock)
+                    rpc._dispatch_stream(proto, sock)
                 except (RpcError, OSError):
                     # NoiseError subclasses OSError: security failures
                     # drop the stream like any dead connection
@@ -166,6 +166,33 @@ class RpcServer:
         self._server.server_close()
 
     # -- request dispatch -------------------------------------------------------
+
+    def _dispatch_stream(self, proto: str, sock):
+        """One protocol stream → its handler (shared between dedicated
+        sockets and mux substreams, so new protocols work over both)."""
+        if proto == M.PROTO_GOSSIP:
+            self.node._handle_gossip_stream(sock)
+            return
+        self._handle_rpc(proto, sock)
+
+    def _serve_mux(self, sock):
+        """Serve many RPC substreams over one connection (the yamux
+        layer, network/mux.py). Each inbound stream opens with its own
+        protocol id and is handled exactly like a dedicated socket."""
+        from .mux import MuxedConnection
+
+        rpc = self
+
+        def on_stream(stream):
+            try:
+                rpc._dispatch_stream(_recv_protocol(stream), stream)
+            except (RpcError, OSError):
+                pass
+            finally:
+                stream.close()
+
+        conn = MuxedConnection(sock, initiator=False, on_stream=on_stream)
+        conn._reader.join()  # handler thread lives as long as the conn
 
     def _peer_key(self, sock) -> str:
         """Bucket key: the noise-authenticated identity when the stream is
@@ -271,12 +298,18 @@ class RpcClient:
     """One-shot request streams to a peer (rpc/outbound.rs analog)."""
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
-                 transport=None):
+                 transport=None, mux: bool = False):
         self.addr = (host, port)
         self.timeout = timeout
         self.transport = transport  # None = plain TCP
+        # mux=True: one persistent (noise-handshaked once) connection
+        # carries every request as a substream — the yamux shape. False:
+        # one TCP connection per request stream.
+        self.mux = mux
+        self._mux_conn = None
+        self._mux_lock = threading.Lock()
 
-    def _open(self, proto: str):
+    def _dial(self):
         sock = socket.create_connection(self.addr, timeout=self.timeout)
         if self.transport is not None:
             try:
@@ -284,8 +317,32 @@ class RpcClient:
             except Exception:
                 sock.close()
                 raise
+        return sock
+
+    def _open(self, proto: str):
+        if self.mux:
+            from .mux import MuxedConnection
+
+            with self._mux_lock:
+                if self._mux_conn is None or not self._mux_conn.alive:
+                    sock = self._dial()
+                    _send_protocol(sock, M.PROTO_MUX)
+                    # the conn replaces the dial timeout with its own IO
+                    # timeout: sends stay bounded, idle reads just retry
+                    self._mux_conn = MuxedConnection(sock, initiator=True)
+                stream = self._mux_conn.open_stream()
+            stream.settimeout(self.timeout)
+            _send_protocol(stream, proto)
+            return stream
+        sock = self._dial()
         _send_protocol(sock, proto)
         return sock
+
+    def close(self):
+        with self._mux_lock:
+            if self._mux_conn is not None:
+                self._mux_conn.close()
+                self._mux_conn = None
 
     def _request_one(self, proto: str, payload: bytes) -> bytes:
         with self._open(proto) as sock:

@@ -24,7 +24,7 @@ from ..network import NetworkService
 from ..state_processing import interop_genesis_state
 from ..store import HotColdDB, MemoryStore
 from ..utils.slot_clock import ManualSlotClock
-from ..validator_client import LocalBeaconNode, ValidatorClient
+from ..validator_client import GossipingBeaconNode, ValidatorClient
 from ..validator_client.beacon_node_fallback import AllNodesFailed, BeaconNodeFallback
 
 SIM_GENESIS_TIME = 1_600_000_000
@@ -34,15 +34,13 @@ class NodeOffline(RuntimeError):
     pass
 
 
-class NetworkedBeaconNode(LocalBeaconNode):
-    """BeaconNodeInterface over a chain + its gossip network: publishes go
-    to the local chain AND out over gossip (publish_blocks.rs semantics:
-    import locally, broadcast to peers). Supports being killed, after
-    which every call raises — the dead-BN seam fallback_sim exercises."""
+class NetworkedBeaconNode(GossipingBeaconNode):
+    """The product GossipingBeaconNode (import locally, broadcast to
+    peers) plus a kill switch: offline nodes raise on every call — the
+    dead-BN seam fallback_sim exercises."""
 
     def __init__(self, chain, network: NetworkService):
-        super().__init__(chain)
-        self.network = network
+        super().__init__(chain, network)
         self.offline = False
 
     def _check(self):
@@ -63,16 +61,11 @@ class NetworkedBeaconNode(LocalBeaconNode):
 
     def publish_block(self, signed_block):
         self._check()
-        root = super().publish_block(signed_block)
-        self.network.publish_block(signed_block)
-        return root
+        return super().publish_block(signed_block)
 
     def publish_attestations(self, attestations):
         self._check()
-        results = super().publish_attestations(attestations)
-        for att in attestations:
-            self.network.publish_attestation(att)
-        return results
+        return super().publish_attestations(attestations)
 
 
 @dataclass

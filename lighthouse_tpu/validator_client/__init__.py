@@ -211,6 +211,41 @@ class LocalBeaconNode(BeaconNodeInterface):
         return out
 
 
+class GossipingBeaconNode(LocalBeaconNode):
+    """LocalBeaconNode that ALSO broadcasts published objects over the
+    node's gossip network — the production publish semantics
+    (http_api/src/publish_blocks.rs: import locally, then broadcast).
+    ClientBuilder wires this when the node has networking; the simulator
+    adds its offline seam on top."""
+
+    def __init__(self, chain, network):
+        super().__init__(chain)
+        self.network = network
+
+    def publish_block(self, signed_block):
+        root = super().publish_block(signed_block)
+        self.network.publish_block(signed_block)
+        return root
+
+    def publish_attestations(self, attestations):
+        results = super().publish_attestations(attestations)
+        for att in attestations:
+            self.network.publish_attestation(att)
+        return results
+
+    def publish_sync_committee_messages(self, messages):
+        super().publish_sync_committee_messages(messages)
+        for msg in messages:
+            self.network.publish_sync_committee_message(msg)
+
+    def publish_aggregates(self, signed_aggregates):
+        results = super().publish_aggregates(signed_aggregates)
+        for agg, res in zip(signed_aggregates, results):
+            if not isinstance(res, Exception):
+                self.network.publish_aggregate(agg)
+        return results
+
+
 class DutiesService:
     """Polls the BN state for this store's duties (duties_service.rs)."""
 

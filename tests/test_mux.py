@@ -1,0 +1,241 @@
+"""Stream multiplexing (network/mux.py + RpcClient mux mode).
+
+Unit: many concurrent logical streams over one socketpair, interleaved
+frames, FIN/RST semantics, reader-death EOF. Integration: a mux-mode
+RpcClient reuses ONE connection (and one Noise handshake) across many
+requests against a live node, with the full stack also running muxed
+over noise."""
+
+import socket
+import threading
+import time
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.network import NetworkService
+from lighthouse_tpu.network.mux import MuxedConnection, MuxError
+from lighthouse_tpu.network.noise import NoiseTransport
+from lighthouse_tpu.network.rpc import RpcClient
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+
+def _conn_pair():
+    sa, sb = socket.socketpair()
+    client = MuxedConnection(sa, initiator=True)
+    server = MuxedConnection(sb, initiator=False)
+    return client, server
+
+
+def test_many_streams_interleaved():
+    client, server = _conn_pair()
+    streams = [client.open_stream() for _ in range(8)]
+    # interleave writes across all streams
+    for rnd in range(5):
+        for i, s in enumerate(streams):
+            s.sendall(bytes([i]) * (rnd + 1))
+    got = {}
+    for _ in range(8):
+        s = server.accept(timeout=5)
+        assert s is not None
+        got[s.stream_id] = s
+    # initiator ids are odd (yamux convention)
+    assert all(sid % 2 == 1 for sid in got)
+    for i, s in enumerate(streams):
+        srv = got[s.stream_id]
+        data = bytearray()
+        while len(data) < 1 + 2 + 3 + 4 + 5:
+            data += srv.recv(64)
+        assert bytes(data) == bytes([i]) * 15
+    # echo back on one stream
+    got[streams[3].stream_id].sendall(b"echo")
+    assert streams[3].recv(16) == b"echo"
+    client.close()
+    server.close()
+
+
+def test_fin_gives_eof_and_reset_raises():
+    client, server = _conn_pair()
+    s = client.open_stream()
+    s.sendall(b"payload")
+    srv = server.accept(timeout=5)
+    assert srv.recv(64) == b"payload"
+    s.shutdown(socket.SHUT_WR)  # FIN
+    assert srv.recv(64) == b""  # clean EOF
+    # RST on another stream surfaces as an error
+    s2 = client.open_stream()
+    srv2 = server.accept(timeout=5)
+    from lighthouse_tpu.network.mux import FLAG_RST
+
+    client.send_frame(s2.stream_id, FLAG_RST, b"")
+    with pytest.raises(MuxError):
+        srv2.settimeout(5)
+        srv2.recv(1)
+    client.close()
+    server.close()
+
+
+def test_connection_death_eofs_all_streams():
+    client, server = _conn_pair()
+    s1, s2 = client.open_stream(), client.open_stream()
+    server.close()  # underlying socket dies
+    time.sleep(0.2)
+    s1.settimeout(2)
+    s2.settimeout(2)
+    assert s1.recv(1) == b""
+    assert s2.recv(1) == b""
+    assert not client.alive
+
+
+def test_big_transfer_spans_frames():
+    client, server = _conn_pair()
+    s = client.open_stream()
+    big = b"ABCD" * 100_000  # 400 KB > 64 KB frame cap
+    t = threading.Thread(target=s.sendall, args=(big,))
+    t.start()
+    srv = server.accept(timeout=5)
+    data = bytearray()
+    while len(data) < len(big):
+        chunk = srv.recv(1 << 16)
+        assert chunk
+        data += chunk
+    t.join()
+    assert bytes(data) == big
+    client.close()
+    server.close()
+
+
+def _harness(slots=0):
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, E, validator_count=16)
+    if slots:
+        h.extend_chain(slots)
+    return h
+
+
+def test_mux_client_reuses_one_connection():
+    a = _harness(slots=8)
+    na = NetworkService(a.chain).start()
+    try:
+        client = RpcClient("127.0.0.1", na.port, mux=True)
+        first_conn = None
+        for i in range(10):
+            status = client.status(na.local_status())
+            assert int(status.head_slot) == a.chain.head_state.slot
+            assert client.ping(i) >= 1
+            if first_conn is None:
+                first_conn = client._mux_conn
+            assert client._mux_conn is first_conn  # same connection
+        blocks = client.blocks_by_range(1, 4, na.decode_block)
+        assert blocks
+        client.close()
+    finally:
+        na.stop()
+
+
+def test_full_stack_muxed_over_noise():
+    """Range sync + gossip between two nodes whose RPC substreams ride
+    ONE noise-secured muxed connection per peer direction."""
+    a = _harness(slots=E.SLOTS_PER_EPOCH)
+    b = _harness()
+    na = NetworkService(a.chain, transport=NoiseTransport()).start()
+    nb = NetworkService(b.chain, transport=NoiseTransport()).start()
+    try:
+        b.slot_clock.set_slot(a.chain.head_state.slot)
+        peer = nb.connect("127.0.0.1", na.port)
+        assert peer.client.mux
+        nb.sync.sync_with(peer)
+        assert b.chain.head_root == a.chain.head_root
+        # the whole sync ran over one muxed connection
+        assert peer.client._mux_conn is not None and peer.client._mux_conn.alive
+        time.sleep(0.2)
+        slot = a.chain.head_state.slot + 1
+        a.slot_clock.set_slot(slot)
+        b.slot_clock.set_slot(slot)
+        root, signed = a.add_block_at_slot(slot)
+        na.publish_block(signed)
+        deadline = time.time() + 10
+        while time.time() < deadline and b.chain.head_root != root:
+            time.sleep(0.05)
+        assert b.chain.head_root == root
+    finally:
+        na.stop()
+        nb.stop()
+
+
+def test_mux_connection_survives_idle_beyond_dial_timeout():
+    """The dial timeout must not linger on the shared connection — an
+    idle mux conn stays alive (liveness is per-stream + TCP)."""
+    a = _harness(slots=4)
+    na = NetworkService(a.chain).start()
+    try:
+        client = RpcClient("127.0.0.1", na.port, timeout=0.5, mux=True)
+        assert client.ping(1) >= 1
+        conn = client._mux_conn
+        time.sleep(1.2)  # idle for > 2x the dial timeout
+        assert conn.alive
+        assert client.ping(2) >= 1  # same connection still serves
+        assert client._mux_conn is conn
+        client.close()
+    finally:
+        na.stop()
+
+
+def test_oversized_frame_kills_connection():
+    """A wire-claimed length beyond the frame cap must not drive the
+    allocation — the connection dies instead."""
+    import struct as _struct
+
+    sa, sb = socket.socketpair()
+    server = MuxedConnection(sb, initiator=False)
+    # handcraft a header claiming a 512 MiB frame
+    sa.sendall(_struct.pack(">IBI", 1, 1, 512 << 20))
+    deadline = time.time() + 5
+    while time.time() < deadline and server.alive:
+        time.sleep(0.05)
+    assert not server.alive
+    sa.close()
+
+
+def test_unsolicited_syn_on_client_conn_is_reset():
+    """An outbound (RPC-client) connection RSTs inbound SYNs instead of
+    queueing streams nobody will consume."""
+    import struct as _struct
+
+    sa, sb = socket.socketpair()
+    client = MuxedConnection(sa, initiator=True)
+    # the "server" side speaks raw frames: send SYN for stream 2
+    sb.sendall(_struct.pack(">IBI", 2, 1, 0))
+    # expect an RST frame for stream 2 back
+    hdr = b""
+    sb.settimeout(5)
+    while len(hdr) < 9:
+        hdr += sb.recv(9 - len(hdr))
+    sid, flags, length = _struct.unpack(">IBI", hdr)
+    assert sid == 2 and flags & 4  # FLAG_RST
+    assert not client._streams  # nothing registered
+    client.close()
+    sb.close()
+
+
+def test_syn_flood_capped():
+    """More concurrent substreams than the cap → RST, not a thread per
+    SYN."""
+    import struct as _struct
+    from lighthouse_tpu.network.mux import MAX_STREAMS_PER_CONN
+
+    sa, sb = socket.socketpair()
+    server = MuxedConnection(sb, initiator=False)  # accepts inbound
+    for sid in range(1, 2 * MAX_STREAMS_PER_CONN, 2):
+        sa.sendall(_struct.pack(">IBI", sid, 1, 0))
+    deadline = time.time() + 5
+    while time.time() < deadline and len(server._streams) < MAX_STREAMS_PER_CONN:
+        time.sleep(0.05)
+    time.sleep(0.3)  # let any excess arrive
+    assert len(server._streams) <= MAX_STREAMS_PER_CONN
+    server.close()
+    sa.close()
