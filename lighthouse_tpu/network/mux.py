@@ -46,6 +46,11 @@ _IO_TIMEOUT = 30.0
 # Concurrent-substream cap per connection: SYN floods cost the attacker a
 # connection, not our thread table.
 MAX_STREAMS_PER_CONN = 256
+# Per-stream receive-buffer cap: the reader drains the socket eagerly, so
+# TCP backpressure alone cannot bound a slow consumer's buffer — a stream
+# whose unread bytes exceed this is reset instead of growing without
+# limit (2× the biggest legal payload).
+MAX_STREAM_BUFFER = 8 << 20
 
 
 class MuxStream:
@@ -53,6 +58,7 @@ class MuxStream:
         self._conn = conn
         self.stream_id = stream_id
         self._buf = deque()
+        self._buffered = 0  # unread bytes queued in _buf
         self._cond = threading.Condition()
         self._eof = False
         self._reset = False
@@ -60,10 +66,16 @@ class MuxStream:
         self._timeout: float | None = None
 
     # -- receive ---------------------------------------------------------
-    def _feed(self, data: bytes):
+    def _feed(self, data: bytes) -> bool:
+        """Queue received plaintext. False = buffer cap exceeded (the
+        connection resets the stream instead of buffering unboundedly)."""
         with self._cond:
+            if self._buffered + len(data) > MAX_STREAM_BUFFER:
+                return False
             self._buf.append(data)
+            self._buffered += len(data)
             self._cond.notify_all()
+        return True
 
     def _feed_eof(self, reset: bool = False):
         with self._cond:
@@ -83,8 +95,10 @@ class MuxStream:
             chunk = self._buf[0]
             if len(chunk) <= n:
                 self._buf.popleft()
+                self._buffered -= len(chunk)
                 return chunk
             self._buf[0] = chunk[n:]
+            self._buffered -= n
             return chunk[:n]
 
     # -- send ------------------------------------------------------------
@@ -245,8 +259,15 @@ class MuxedConnection:
                 stream = self._streams.get(sid)
                 if stream is None:
                     continue  # frame for a stream we already forgot
-                if payload:
-                    stream._feed(payload)
+                if payload and not stream._feed(payload):
+                    # slow consumer past the buffer cap: reset the stream
+                    stream._feed_eof(reset=True)
+                    self._forget(sid)
+                    try:
+                        self.send_frame(sid, FLAG_RST, b"")
+                    except OSError:
+                        pass
+                    continue
                 if flags & FLAG_RST:
                     stream._feed_eof(reset=True)
                 elif flags & FLAG_FIN:

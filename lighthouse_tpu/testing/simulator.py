@@ -67,6 +67,22 @@ class NetworkedBeaconNode(GossipingBeaconNode):
         self._check()
         return super().publish_attestations(attestations)
 
+    def publish_sync_committee_messages(self, messages):
+        self._check()
+        return super().publish_sync_committee_messages(messages)
+
+    def publish_aggregates(self, signed_aggregates):
+        self._check()
+        return super().publish_aggregates(signed_aggregates)
+
+    def get_aggregate(self, data):
+        self._check()
+        return super().get_aggregate(data)
+
+    def prepare_proposers(self, preparations):
+        self._check()
+        return super().prepare_proposers(preparations)
+
 
 @dataclass
 class SimNode:

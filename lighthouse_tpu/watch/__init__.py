@@ -13,6 +13,7 @@ import threading
 
 from ..eth2 import BeaconNodeHttpClient
 from ..utils.http_server import JsonHttpServer, JsonRequestHandler
+from .blockprint import classify_block
 
 
 class WatchDB:
@@ -44,6 +45,12 @@ class WatchDB:
             "CREATE TABLE IF NOT EXISTS suboptimal_attestations ("
             "att_slot INTEGER, included_at INTEGER, delay INTEGER, "
             "PRIMARY KEY (att_slot, included_at))"
+        )
+        # blockprint (client-fingerprint) per proposal (watch/src/blockprint)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS blockprint ("
+            "slot INTEGER PRIMARY KEY, best_guess TEXT, el_guess TEXT, "
+            "graffiti TEXT)"
         )
         self._conn.commit()
 
@@ -159,6 +166,38 @@ class WatchDB:
             ).fetchone()
         return row is not None
 
+    def record_blockprint(self, slot: int, print_: dict):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO blockprint VALUES (?, ?, ?, ?)",
+                (
+                    slot,
+                    print_["best_guess"],
+                    print_.get("el_guess"),
+                    print_.get("graffiti", ""),
+                ),
+            )
+            self._conn.commit()
+
+    def blockprint_for_slot(self, slot: int) -> dict | None:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT best_guess, el_guess, graffiti FROM blockprint "
+                "WHERE slot = ?",
+                (slot,),
+            ).fetchone()
+        if row is None:
+            return None
+        return {"best_guess": row[0], "el_guess": row[1], "graffiti": row[2]}
+
+    def blockprint_shares(self) -> dict[str, int]:
+        """Proposal counts per guessed client (the blockprint aggregate)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT best_guess, COUNT(*) FROM blockprint GROUP BY best_guess"
+            ).fetchall()
+        return {guess: count for guess, count in rows}
+
     def suboptimal_attestation_count(self) -> int:
         with self._lock:
             return self._conn.execute(
@@ -226,6 +265,9 @@ class WatchUpdater:
             recorded += 1
         for signed in packing_jobs:
             self._record_packing(signed, blocks_by_slot)
+            slot = int(signed.message.slot)
+            if self.db.blockprint_for_slot(slot) is None:
+                self.db.record_blockprint(slot, classify_block(signed))
         fin = self.client.get_finality_checkpoints("head")
         self.db.record_finality(
             head_slot,
@@ -285,6 +327,7 @@ class WatchServer(JsonHttpServer):
                     "/v1/finality": lambda: watch_db.latest_finality(),
                     "/v1/packing": lambda: watch_db.packing_stats(),
                     "/v1/gaps": lambda: watch_db.gaps(),
+                    "/v1/blockprint": lambda: watch_db.blockprint_shares(),
                 }
                 fn = routes.get(self.route)
                 if fn is None:

@@ -84,3 +84,49 @@ def test_watch_packing_and_rest_server():
             ws.stop()
     finally:
         server.stop()
+
+
+def test_blockprint_classification_and_aggregate():
+    """Client fingerprints from graffiti/extra_data feed the blockprint
+    table and the /v1/blockprint share aggregate (watch/src/blockprint
+    analog)."""
+    from lighthouse_tpu.watch import WatchDB
+    from lighthouse_tpu.watch.blockprint import classify_block
+    from lighthouse_tpu.types.containers import build_types
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec
+
+    t = build_types(MinimalEthSpec)
+
+    def block(graffiti=b"", slot=1):
+        body = t.BeaconBlockBody(graffiti=graffiti.ljust(32, b"\x00"))
+        return t.SignedBeaconBlock(
+            message=t.BeaconBlock(slot=slot, body=body),
+            signature=b"\x00" * 96,
+        )
+
+    assert classify_block(block(b"Lighthouse/v4.6.0"))["best_guess"] == "Lighthouse"
+    assert classify_block(block(b"teku/23.1"))["best_guess"] == "Teku"
+    assert classify_block(block(b"prysm-rc"))["best_guess"] == "Prysm"
+    got = classify_block(block(b"gm"))
+    assert got["best_guess"] == "Unknown"
+    assert got["graffiti"] == "gm"
+
+    # post-merge: the payload's extra_data identifies the EL
+    bellatrix_body = t.BeaconBlockBodyBellatrix(
+        graffiti=b"Nimbus".ljust(32, b"\x00"),
+        execution_payload=t.ExecutionPayload(extra_data=b"geth go1.21"),
+    )
+    signed = t.SignedBeaconBlockBellatrix(
+        message=t.BeaconBlockBellatrix(slot=2, body=bellatrix_body),
+        signature=b"\x00" * 96,
+    )
+    p = classify_block(signed)
+    assert p["best_guess"] == "Nimbus" and p["el_guess"] == "Geth"
+
+    db = WatchDB()
+    db.record_blockprint(1, classify_block(block(b"Lighthouse", slot=1)))
+    db.record_blockprint(2, p)
+    db.record_blockprint(3, classify_block(block(b"Lighthouse", slot=3)))
+    assert db.blockprint_shares() == {"Lighthouse": 2, "Nimbus": 1}
+    assert db.blockprint_for_slot(2)["el_guess"] == "Geth"
+    assert db.blockprint_for_slot(99) is None
