@@ -179,6 +179,79 @@ def bench_block_import(jax):
     }
 
 
+def bench_state_root(jax):
+    """North-star metric 2: `hash_tree_root` of a BeaconState at 1M
+    validators — the per-slot incremental update (a block's worth of
+    mutations re-rooted through the dirty-leaf caches), plus the cold
+    full-build for context. Control = this state's root via the plain
+    non-cached recompute path."""
+    import random as _r
+    from dataclasses import replace
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.state_processing import interop_genesis_state
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec
+
+    E = MinimalEthSpec
+    bls.set_backend("fake_crypto")
+    n = 5_000 if SMOKE else 1_000_000
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    state = interop_genesis_state(
+        bls.interop_keypairs(8), 1_600_000_000, b"\x42" * 32, spec, E
+    )
+    rng = _r.Random(11)
+    v0 = state.validators[0]
+    vs, bal = [], []
+    for i in range(n):
+        v = v0.copy()
+        v.withdrawal_credentials = i.to_bytes(32, "little")
+        vs.append(v)
+        bal.append(32_000_000_000)
+    state.validators = vs
+    state.balances = bal
+
+    t_cold0 = time.perf_counter()
+    root = state.hash_tree_root()  # builds the caches
+    cold_s = time.perf_counter() - t_cold0
+
+    def mutate_and_root():
+        # a block's worth of churn: ~128 attesting balance changes + a
+        # couple of validator-record updates
+        for _ in range(128):
+            i = rng.randrange(n)
+            state.balances[i] = int(state.balances[i]) + 1
+        for _ in range(2):
+            v = state.validators[rng.randrange(n)]
+            v.effective_balance = int(v.effective_balance)  # touch+memo bust
+            v.slashed = v.slashed
+        return state.hash_tree_root()
+
+    t = _trials(mutate_and_root, n=5)
+
+    # control: the same state through the NON-cached recompute path,
+    # measured on a 1/64 slice and extrapolated (a full recompute at 1M
+    # is minutes — exactly the point of the cache)
+    from lighthouse_tpu.ssz.core import List as SszList
+    from lighthouse_tpu.types.containers import build_types
+
+    ctrl_cls = SszList[build_types(E).Validator, E.VALIDATOR_REGISTRY_LIMIT]
+    ctrl_slice = vs[: max(1, n // 64)]
+    ctrl_cls.hash_tree_root_of(ctrl_slice)  # warm-up: exclude compiles
+    t_ctrl = _trials(lambda: ctrl_cls.hash_tree_root_of(ctrl_slice), n=1)
+    control_s = t_ctrl["median_s"] * 64
+
+    return {
+        "metric": "state_root_update_1m",
+        "value": round(t["median_s"] * 1000, 2),
+        "unit": "ms/update (128-balance + 2-validator churn, re-root)",
+        "vs_baseline": round(control_s / t["median_s"], 2),
+        "baseline_control": "non-cached registry recompute (1/64 slice x64)",
+        "config": {"validators": n, "cold_build_s": round(cold_s, 2)},
+        "spread": t,
+    }
+
+
 def bench_epoch_transition(jax):
     """Altair epoch sweep at 100k validators (single_pass.rs scale test):
     vectorized flag/balance/registry passes over flat arrays."""
@@ -236,6 +309,7 @@ _METRICS = {
     "merkle": bench_merkle,
     "block_import": bench_block_import,
     "epoch_transition": bench_epoch_transition,
+    "state_root": bench_state_root,
     "bls": bench_bls,
 }
 
@@ -290,11 +364,17 @@ def main():
 
     # the headline metric runs FIRST with the lion's share of the budget
     # (secondary metrics must never starve the number this bench exists
-    # to produce); ~7 min is reserved for the cheap metrics after it
-    head = run_metric("bls", cap=max(budget - 420, budget * 0.5))
+    # to produce); the per-metric caps below sum to the ~10 min reserve
+    head = run_metric("bls", cap=max(budget - 600, budget * 0.5))
 
-    for name in ("merkle", "block_import", "epoch_transition"):
-        result = run_metric(name, cap=min(300, deadline - time.monotonic()))
+    secondary_caps = {
+        "merkle": 180,
+        "state_root": 240,  # 1M-validator build + fresh tree shapes
+        "block_import": 90,
+        "epoch_transition": 90,
+    }
+    for name, cap in secondary_caps.items():
+        result = run_metric(name, cap=min(cap, deadline - time.monotonic()))
         if result is not None:
             details.append(result)
     if head is None:

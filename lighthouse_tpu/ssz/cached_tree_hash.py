@@ -68,8 +68,10 @@ def _build_layers(leaves: np.ndarray) -> list[np.ndarray]:
         levels = merkle_tree_levels(jax.device_put(words))
         # levels: [root, ..., leaves] as [m, 8] u32 big-endian words
         return [
+            # astype(copy=True, order="C") guarantees a fresh contiguous
+            # array — device_get may hand back strided views
             np.asarray(jax.device_get(lv))
-            .astype(">u4")
+            .astype(">u4", order="C")
             .view(np.uint8)
             .reshape(-1, 32)
             for lv in reversed(levels)

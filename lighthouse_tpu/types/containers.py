@@ -43,6 +43,16 @@ BLSSignature = Bytes96
 ExecutionAddress = Bytes20
 
 
+def _state_hash_tree_root(self) -> bytes:
+    """Shared BeaconState hash_tree_root hook: registry-scale fields ride
+    the incremental caches (cached_tree_hash analog;
+    beacon_state.rs:2002-2004). Assigned on BOTH state families — phase0
+    and Altair+ are separate class hierarchies."""
+    from ..ssz.cached_tree_hash import cached_state_root
+
+    return cached_state_root(self)
+
+
 @functools.cache
 def build_types(E: type) -> SimpleNamespace:
     """Build the full container family for preset `E` (an EthSpec subclass)."""
@@ -195,12 +205,9 @@ def build_types(E: type) -> SimpleNamespace:
         current_justified_checkpoint: Checkpoint
         finalized_checkpoint: Checkpoint
 
-        def hash_tree_root(self) -> bytes:
-            # incremental per-field caches for the registry-scale fields
-            # (cached_tree_hash analog; beacon_state.rs:2002-2004)
-            from ..ssz.cached_tree_hash import cached_state_root
-
-            return cached_state_root(self)
+        # incremental per-field caches for the registry-scale fields
+        # (cached_tree_hash analog; beacon_state.rs:2002-2004)
+        hash_tree_root = _state_hash_tree_root
 
     class AggregateAndProof(Container):
         aggregator_index: uint64
@@ -285,6 +292,10 @@ def build_types(E: type) -> SimpleNamespace:
         inactivity_scores: List[uint64, E.VALIDATOR_REGISTRY_LIMIT]
         current_sync_committee: SyncCommittee
         next_sync_committee: SyncCommittee
+
+        # Altair+ states are NOT subclasses of the phase0 BeaconState
+        # (different field layout), so they need their own hook
+        hash_tree_root = _state_hash_tree_root
 
     # -- Bellatrix (execution payloads) ------------------------------------
 

@@ -151,3 +151,53 @@ def test_cached_root_through_state_transition():
     h.extend_chain(E.SLOTS_PER_EPOCH + 3)
     st = h.chain.head_state
     assert st.hash_tree_root() == _fresh_root(st)
+
+
+def test_altair_and_electra_states_use_cache_and_match_plain_roots():
+    """Altair+ states are not subclasses of the phase0 BeaconState, so
+    they carry their own cached hash_tree_root hook — roots must equal
+    the from-scratch classmethod computation through arbitrary churn."""
+    import random
+    from dataclasses import replace
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.state_processing import interop_genesis_state
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    bls.set_backend("fake_crypto")
+    rng = random.Random(5)
+    for forks in (
+        dict(altair_fork_epoch=0),
+        dict(
+            altair_fork_epoch=0,
+            bellatrix_fork_epoch=0,
+            capella_fork_epoch=0,
+            deneb_fork_epoch=0,
+            electra_fork_epoch=0,
+        ),
+    ):
+        spec = replace(minimal_spec(), **forks)
+        state = interop_genesis_state(
+            bls.interop_keypairs(8), 1_600_000_000, b"\x42" * 32, spec, E
+        )
+        plain = type(state).hash_tree_root_of(state)
+        assert state.hash_tree_root() == plain
+        assert "_thc_cache" in state.__dict__  # the cache really engaged
+        # churn: balances, validator record, participation, randao
+        for _ in range(5):
+            i = rng.randrange(len(state.balances))
+            state.balances[i] = int(state.balances[i]) + rng.randrange(100)
+            v = state.validators[rng.randrange(len(state.validators))]
+            v.effective_balance = 31_000_000_000
+            state.current_epoch_participation[
+                rng.randrange(len(state.current_epoch_participation))
+            ] = rng.randrange(8)
+            state.randao_mixes[rng.randrange(8)] = rng.randbytes(32)
+            assert state.hash_tree_root() == type(state).hash_tree_root_of(state)
+        # copies share nothing observable: mutate the copy, original stable
+        snap = state.hash_tree_root()
+        cp = state.copy()
+        cp.balances[0] = 1
+        assert cp.hash_tree_root() != snap
+        assert state.hash_tree_root() == snap
