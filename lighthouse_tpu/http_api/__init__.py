@@ -31,6 +31,24 @@ def _hex(b: bytes) -> str:
     return "0x" + bytes(b).hex()
 
 
+def _container_json(value):
+    """Generic SSZ container → beacon-API JSON (ints as strings, bytes as
+    0x-hex, lists recursed)."""
+    from ..ssz.core import Container
+
+    if isinstance(value, Container):
+        return {f: _container_json(getattr(value, f)) for f in value._fields}
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return _hex(bytes(value))
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, int):
+        return str(value)
+    if isinstance(value, (list, tuple)):
+        return [_container_json(v) for v in value]
+    return value
+
+
 def _validator_json(i: int, v, balance: int) -> dict:
     return {
         "index": str(i),
@@ -239,6 +257,136 @@ class BeaconApi:
                 continue
             out.append(_validator_json(i, v, st.balances[i]))
         return {"data": out, "execution_optimistic": False, "finalized": False}
+
+    def state_validator(self, state_id: str, validator_id: str):
+        """GET /states/{id}/validators/{validator_id} (index or pubkey)."""
+        st = self._state(state_id)
+        if validator_id.isdigit():
+            i = int(validator_id)
+            if i >= len(st.validators):
+                raise ApiError(404, "validator index out of range")
+        else:
+            want = validator_id.lower()
+            for i, v in enumerate(st.validators):
+                if _hex(v.pubkey) == want:
+                    break
+            else:
+                raise ApiError(404, "unknown validator pubkey")
+        return {
+            "data": _validator_json(i, st.validators[i], st.balances[i]),
+            "execution_optimistic": False,
+            "finalized": False,
+        }
+
+    def state_validator_balances(self, state_id: str, indices=None):
+        """GET /states/{id}/validator_balances."""
+        st = self._state(state_id)
+        out = []
+        for i, v in enumerate(st.validators):
+            if indices and i not in indices and _hex(v.pubkey) not in indices:
+                continue
+            out.append({"index": str(i), "balance": str(int(st.balances[i]))})
+        return {"data": out, "execution_optimistic": False, "finalized": False}
+
+    def state_randao(self, state_id: str, epoch=None):
+        """GET /states/{id}/randao. Epochs outside the stored historical
+        window are 400 (the vector would alias an unrelated mix)."""
+        from ..state_processing.accessors import (
+            get_current_epoch,
+            get_randao_mix,
+        )
+
+        st = self._state(state_id)
+        E = self.chain.E
+        current = get_current_epoch(st, E)
+        ep = int(epoch) if epoch is not None else current
+        if not (current - E.EPOCHS_PER_HISTORICAL_VECTOR < ep <= current):
+            raise ApiError(
+                400,
+                f"epoch {ep} outside the stored randao window "
+                f"({max(0, current - E.EPOCHS_PER_HISTORICAL_VECTOR + 1)}"
+                f"..{current})",
+            )
+        return {
+            "data": {"randao": _hex(get_randao_mix(st, ep, E))},
+            "execution_optimistic": False,
+            "finalized": False,
+        }
+
+    def node_peer_count(self):
+        """GET /eth/v1/node/peer_count."""
+        n = len(self.network.peers.peers()) if self.network else 0
+        return {
+            "data": {
+                "disconnected": "0",
+                "connecting": "0",
+                "connected": str(n),
+                "disconnecting": "0",
+            }
+        }
+
+    def pool_proposer_slashings(self):
+        pool = self.chain.op_pool
+        return {
+            "data": [
+                _container_json(s)
+                for s in list(pool._proposer_slashings.values())
+            ]
+        }
+
+    def pool_attester_slashings(self):
+        pool = self.chain.op_pool
+        return {
+            "data": [_container_json(s) for s in list(pool._attester_slashings)]
+        }
+
+    def publish_proposer_slashing_ssz(self, data: bytes) -> int:
+        """POST /eth/v1/beacon/pool/proposer_slashings (SSZ body)."""
+        t = self.chain.types
+        try:
+            slashing = t.ProposerSlashing.deserialize(data)
+            self.chain.process_proposer_slashing(slashing)
+        except Exception as e:  # noqa: BLE001 — bad request, not node fault
+            raise ApiError(400, f"invalid proposer slashing: {e}") from e
+        if self.network is not None:
+            self.network.publish_proposer_slashing(slashing)
+        return 200
+
+    def publish_attester_slashing_ssz(self, data: bytes) -> int:
+        """POST /eth/v1/beacon/pool/attester_slashings (SSZ body)."""
+        t = self.chain.types
+        try:
+            slashing = t.AttesterSlashing.deserialize(data)
+            self.chain.process_attester_slashing(slashing)
+        except Exception as e:  # noqa: BLE001
+            raise ApiError(400, f"invalid attester slashing: {e}") from e
+        if self.network is not None:
+            self.network.publish_attester_slashing(slashing)
+        return 200
+
+    def block_rewards(self, block_id: str):
+        """GET /eth/v1/beacon/rewards/blocks/{block_id} — per-component
+        proposer rewards via staged replay (rewards.py)."""
+        from ..beacon_chain.rewards import compute_block_rewards
+
+        root, signed = self._block(block_id)
+        chain = self.chain
+        parent_state = chain.state_for_block_root(
+            bytes(signed.message.parent_root)
+        )
+        if parent_state is None:
+            raise ApiError(404, "parent state unavailable for reward replay")
+        try:
+            data = compute_block_rewards(
+                signed, parent_state, chain.spec, chain.E, chain.types
+            )
+        except ValueError as e:
+            raise ApiError(400, str(e)) from e
+        return {
+            "data": data,
+            "execution_optimistic": False,
+            "finalized": False,
+        }
 
     def block_header(self, block_id: str):
         root, signed = self._block(block_id)
@@ -735,6 +883,37 @@ _ROUTES = [
         r"^/eth/v1/beacon/states/(?P<state_id>[^/]+)/validators$",
         "state_validators",
     ),
+    (
+        "GET",
+        r"^/eth/v1/beacon/states/(?P<state_id>[^/]+)/validators/(?P<validator_id>[^/]+)$",
+        "state_validator",
+    ),
+    (
+        "GET",
+        r"^/eth/v1/beacon/states/(?P<state_id>[^/]+)/validator_balances$",
+        "state_validator_balances",
+    ),
+    (
+        "GET",
+        r"^/eth/v1/beacon/states/(?P<state_id>[^/]+)/randao$",
+        "state_randao",
+    ),
+    ("GET", r"^/eth/v1/node/peer_count$", "node_peer_count"),
+    (
+        "GET",
+        r"^/eth/v1/beacon/pool/proposer_slashings$",
+        "pool_proposer_slashings",
+    ),
+    (
+        "GET",
+        r"^/eth/v1/beacon/pool/attester_slashings$",
+        "pool_attester_slashings",
+    ),
+    (
+        "GET",
+        r"^/eth/v1/beacon/rewards/blocks/(?P<block_id>[^/]+)$",
+        "block_rewards",
+    ),
     ("GET", r"^/eth/v1/beacon/headers/(?P<block_id>[^/]+)$", "block_header"),
     ("GET", r"^/eth/v1/beacon/blocks/(?P<block_id>[^/]+)/root$", "block_root"),
     ("GET", r"^/eth/v1/validator/duties/proposer/(?P<epoch>\d+)$", "proposer_duties"),
@@ -868,15 +1047,21 @@ class _Handler(BaseHTTPRequestHandler):
                         k: (int(v) if v.isdigit() and k == "epoch" else v)
                         for k, v in m.groupdict().items()
                     }
-                    if fn_name == "state_validators":
+                    if fn_name in ("state_validators", "state_validator_balances"):
                         q = parse_qs(parsed.query)
                         ids = q.get("id")
                         if ids:
                             ids = [
-                                int(x) if x.isdigit() else x
+                                int(x) if x.isdigit() else x.lower()
                                 for x in ids[0].split(",")
                             ]
                         kwargs["indices"] = ids
+                    elif fn_name == "state_randao":
+                        q = parse_qs(parsed.query)
+                        ep = q.get("epoch", [None])[0]
+                        if ep is not None and not ep.isdigit():
+                            raise ApiError(400, f"bad epoch {ep!r}")
+                        kwargs["epoch"] = int(ep) if ep is not None else None
                     self._send_json(getattr(self.api, fn_name)(**kwargs))
                     return
             raise ApiError(404, f"unknown route {path}")
@@ -954,6 +1139,14 @@ class _Handler(BaseHTTPRequestHandler):
                         415, "JSON exit publishing not supported; use SSZ"
                     )
                 code = self.api.publish_voluntary_exit_ssz(body)
+                self._send_json({"code": code, "message": "ok"}, code)
+                return
+            if path == "/eth/v1/beacon/pool/proposer_slashings":
+                code = self.api.publish_proposer_slashing_ssz(body)
+                self._send_json({"code": code, "message": "ok"}, code)
+                return
+            if path == "/eth/v1/beacon/pool/attester_slashings":
+                code = self.api.publish_attester_slashing_ssz(body)
                 self._send_json({"code": code, "message": "ok"}, code)
                 return
             m = re.match(
