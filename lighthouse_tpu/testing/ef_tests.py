@@ -534,6 +534,186 @@ class TransitionHandler(Handler):
         _expect_post(case, ctx, state, mutate)
 
 
+def anchor_root_of(anchor_state, types) -> bytes:
+    """Anchor root: the state's latest_block_header with its state root
+    filled — identical to hash_tree_root(anchor_block) on canonical
+    vectors, and correct for fork-at-genesis states whose header was
+    carried through the phase0 upgrade path. Shared by the handler and
+    the golden generator so the two cannot diverge."""
+    hdr = anchor_state.latest_block_header
+    return types.BeaconBlockHeader(
+        slot=hdr.slot,
+        proposer_index=hdr.proposer_index,
+        parent_root=hdr.parent_root,
+        state_root=anchor_state.hash_tree_root()
+        if bytes(hdr.state_root) == b"\x00" * 32
+        else hdr.state_root,
+        body_root=hdr.body_root,
+    ).hash_tree_root()
+
+
+def block_is_timely(block_slot: int, current_slot: int, last_tick: int,
+                    genesis_time: int, seconds_per_slot: int) -> bool:
+    """Proposer-boost timeliness: the block's slot is current and the
+    last tick lands in the first third of it."""
+    return (
+        int(block_slot) == current_slot
+        and ((last_tick - genesis_time) % seconds_per_slot) * 3
+        < seconds_per_slot
+    )
+
+
+class ForkChoiceHandler(Handler):
+    """fork_choice/* (handler.rs ForkChoiceHandler, cases/fork_choice.rs):
+    drive a ForkChoice store from an anchor with tick/block/attestation
+    steps and assert the head/checkpoint expectations after each `checks`
+    step. Ticks are seconds since the Unix epoch (the spec's store.time);
+    a block is timely when its tick lands in the first third of its slot
+    (proposer boost)."""
+
+    runner = "fork_choice"
+
+    def __init__(self, handler: str):
+        self.handler = handler
+
+    def run(self, case: Case, ctx: Context):
+        from ..fork_choice.fork_choice import ForkChoice, ForkChoiceError
+        from ..state_processing import (
+            BlockSignatureStrategy,
+            per_block_processing,
+        )
+        from ..state_processing.accessors import get_indexed_attestation
+
+        anchor_state = ctx.tf.BeaconState.deserialize(
+            case.ssz_bytes("anchor_state")
+        )
+        case.ssz_bytes("anchor_block")  # present per format; root from state
+        anchor_root = anchor_root_of(anchor_state, ctx.types)
+        fc = ForkChoice.from_anchor(anchor_root, anchor_state, ctx.spec, ctx.E)
+        states = {anchor_root: anchor_state}
+        genesis_time = int(anchor_state.genesis_time)
+        spb = ctx.spec.seconds_per_slot
+        current_slot = int(anchor_state.slot)
+        last_tick = genesis_time + current_slot * spb
+        strategy = (
+            BlockSignatureStrategy.VERIFY_BULK
+            if _verify_sigs()
+            else BlockSignatureStrategy.NO_VERIFICATION
+        )
+
+        for step in case.yaml("steps"):
+            if "tick" in step:
+                last_tick = int(step["tick"])
+                current_slot = max(
+                    current_slot, (last_tick - genesis_time) // spb
+                )
+                fc.on_tick(current_slot)
+            elif "block" in step:
+                signed = ctx.tf.SignedBeaconBlock.deserialize(
+                    case.ssz_bytes(step["block"])
+                )
+                block = signed.message
+                valid = step.get("valid", True)
+                try:
+                    parent = states.get(bytes(block.parent_root))
+                    if parent is None:
+                        raise ForkChoiceError("unknown parent")
+                    post = parent.copy()
+                    while post.slot < block.slot:
+                        per_slot_processing(post, ctx.spec, ctx.E)
+                    per_block_processing(
+                        post, signed, ctx.spec, ctx.E, strategy=strategy
+                    )
+                    root = block.hash_tree_root()
+                    timely = block_is_timely(
+                        block.slot, current_slot, last_tick, genesis_time, spb
+                    )
+                    fc.on_block(
+                        current_slot, block, root, post, is_timely=timely
+                    )
+                except Exception as e:  # noqa: BLE001 — judged by `valid`
+                    if valid:
+                        raise CaseFailure(
+                            f"{case.path}: valid block rejected: {e}"
+                        ) from e
+                    continue
+                if not valid:
+                    raise CaseFailure(
+                        f"{case.path}: invalid block {step['block']} accepted"
+                    )
+                states[root] = post
+            elif "attestation" in step:
+                valid = step.get("valid", True)
+                try:
+                    att = ctx.types.Attestation.deserialize(
+                        case.ssz_bytes(step["attestation"])
+                    )
+                    src = states.get(bytes(att.data.beacon_block_root))
+                    if src is None:
+                        raise ForkChoiceError("attestation for unknown block")
+                    st = src.copy()
+                    while st.slot < int(att.data.slot):
+                        per_slot_processing(st, ctx.spec, ctx.E)
+                    fc.on_attestation(get_indexed_attestation(st, att, ctx.E))
+                except Exception as e:  # noqa: BLE001 — judged by `valid`
+                    if valid:
+                        raise CaseFailure(
+                            f"{case.path}: valid attestation rejected: {e}"
+                        ) from e
+                    continue
+                if not valid:
+                    raise CaseFailure(
+                        f"{case.path}: invalid attestation accepted"
+                    )
+            elif "attester_slashing" in step:
+                slashing = ctx.types.AttesterSlashing.deserialize(
+                    case.ssz_bytes(step["attester_slashing"])
+                )
+                both = set(
+                    int(i) for i in slashing.attestation_1.attesting_indices
+                ) & set(int(i) for i in slashing.attestation_2.attesting_indices)
+                fc.on_equivocation(sorted(both))
+            elif "checks" in step:
+                checks = step["checks"]
+                head = fc.get_head(current_slot)
+                if "head" in checks:
+                    want = checks["head"]
+                    if head.hex() != want["root"].removeprefix("0x"):
+                        raise CaseFailure(
+                            f"{case.path}: head {head.hex()[:12]} != "
+                            f"{want['root'][:14]}"
+                        )
+                    got_slot = int(states[head].slot)
+                    if int(want["slot"]) != got_slot:
+                        raise CaseFailure(
+                            f"{case.path}: head slot {got_slot} != {want['slot']}"
+                        )
+                for key, cp in (
+                    ("justified_checkpoint", fc.store.justified_checkpoint),
+                    ("finalized_checkpoint", fc.store.finalized_checkpoint),
+                ):
+                    if key in checks:
+                        want = checks[key]
+                        if (
+                            int(want["epoch"]) != cp.epoch
+                            or want["root"].removeprefix("0x") != cp.root.hex()
+                        ):
+                            raise CaseFailure(
+                                f"{case.path}: {key} ({cp.epoch}, "
+                                f"{cp.root.hex()[:12]}) != {want}"
+                            )
+                if "proposer_boost_root" in checks:
+                    want = checks["proposer_boost_root"].removeprefix("0x")
+                    got = fc.store.proposer_boost_root.hex()
+                    if want != got:
+                        raise CaseFailure(
+                            f"{case.path}: proposer_boost_root {got[:12]} != "
+                            f"{want[:12]}"
+                        )
+            else:
+                raise CaseFailure(f"{case.path}: unknown step {step}")
+
+
 # ---------------------------------------------------------------------------
 # The walker
 # ---------------------------------------------------------------------------
@@ -558,6 +738,8 @@ def _handler_for(runner: str, handler: str) -> Handler | None:
         return ForkUpgradeHandler()
     if runner == "transition" and handler == "core":
         return TransitionHandler()
+    if runner == "fork_choice":
+        return ForkChoiceHandler(handler)
     return None
 
 
