@@ -31,6 +31,7 @@ from ..state_processing.accessors import (
 from ..store import HotColdDB
 from ..types.chain_spec import ChainSpec
 from ..utils.slot_clock import SlotClock
+from ..utils.tracing import span
 from .attestation_verification import (
     AttestationError,
     AttestationVerifier,
@@ -463,7 +464,9 @@ class BeaconChain:
         from ..metrics import inc_counter, start_timer
 
         with self.import_lock.acquire_write():
-            with start_timer("beacon_block_import_seconds"):
+            with start_timer("beacon_block_import_seconds"), span(
+                "block_import"
+            ):
                 root = self._process_block_inner(
                     block_input,
                     segment_verified_roots or (),
@@ -547,17 +550,18 @@ class BeaconChain:
                 else BlockSignatureStrategy.VERIFY_BULK
             )
             try:
-                per_block_processing(
-                    state,
-                    signed_block,
-                    self.spec,
-                    self.E,
-                    strategy=strategy,
-                    ctxt=ctxt,
-                    block_root=block_root,
-                    proposal_already_verified=proposal_verified,
-                    execution_engine=self.execution_layer,
-                )
+                with span("state_transition", slot=int(block.slot)):
+                    per_block_processing(
+                        state,
+                        signed_block,
+                        self.spec,
+                        self.E,
+                        strategy=strategy,
+                        ctxt=ctxt,
+                        block_root=block_root,
+                        proposal_already_verified=proposal_verified,
+                        execution_engine=self.execution_layer,
+                    )
             except BlockProcessingError as e:
                 raise BlockError(f"invalid block: {e}") from e
 
@@ -567,9 +571,10 @@ class BeaconChain:
             and self.slot_clock.seconds_into_slot()
             < self.spec.seconds_per_slot / 3
         )
-        self.fork_choice.on_block(
-            current_slot, block, block_root, state, is_timely=is_timely
-        )
+        with span("fork_choice_on_block"):
+            self.fork_choice.on_block(
+                current_slot, block, block_root, state, is_timely=is_timely
+            )
         for att in block.body.attestations:
             try:
                 indexed = ctxt.get_indexed_attestation(state, att, self.E)

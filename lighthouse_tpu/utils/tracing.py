@@ -1,0 +1,102 @@
+"""Lightweight tracing spans.
+
+The reference threads `tracing` spans through the node (common/logging
+bridges slog+tracing; spans carry timing and parentage). This module is
+the same capability sized to this runtime: context-manager spans that
+
+  * record wall time into the metrics registry (one histogram per span
+    name: `trace_span_seconds_<name>` — Prometheus-visible),
+  * know their parent (contextvars, so they follow the work across
+    threads started with `copy_context` and stay correct under asyncio),
+  * and emit one structured log line per span at close
+    (`span=<name> parent=<name> ms=<dur>`), rate-limited per span name
+    so hot paths don't flood the log.
+
+Usage:
+    with span("block_import", root="0x.."):
+        ...
+    @traced("epoch_transition")
+    def process_epoch(...): ...
+"""
+
+from __future__ import annotations
+
+import contextvars
+import functools
+import time
+
+from ..metrics import REGISTRY
+from .logging import get_logger
+
+log = get_logger("lighthouse_tpu.trace")
+
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "trace_span", default=None
+)
+
+# per-span-name log rate limit (seconds); metrics capture every sample
+_LOG_EVERY = 5.0
+_last_logged: dict[str, float] = {}
+
+
+class Span:
+    __slots__ = ("name", "fields", "parent", "_t0", "_token", "duration_s")
+
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.parent: Span | None = None
+        self.duration_s: float | None = None
+        self._t0 = 0.0
+        self._token = None
+
+    def __enter__(self) -> "Span":
+        self.parent = _current.get()
+        self._token = _current.set(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.duration_s = time.perf_counter() - self._t0
+        _current.reset(self._token)
+        REGISTRY.histogram(
+            f"trace_span_seconds_{self.name}",
+            f"span duration: {self.name}",
+        ).observe(self.duration_s)
+        now = time.monotonic()
+        if now - _last_logged.get(self.name, 0.0) >= _LOG_EVERY:
+            _last_logged[self.name] = now
+            record = {
+                "span": self.name,
+                "parent": self.parent.name if self.parent else None,
+                "ms": round(self.duration_s * 1000, 2),
+                "error": exc_type.__name__ if exc_type else None,
+            }
+            # user fields must not collide with the reserved keys above
+            # (a TypeError in __exit__ would mask the real exception)
+            for k, v in self.fields.items():
+                record.setdefault(k, v)
+            log.info("span", **record)
+        return False  # never swallow
+
+
+def span(name: str, **fields) -> Span:
+    return Span(name, **fields)
+
+
+def current_span() -> Span | None:
+    return _current.get()
+
+
+def traced(name: str):
+    """Decorator form: wraps the function body in a span."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with Span(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

@@ -140,3 +140,43 @@ def test_enr_roundtrip_and_stale_eviction():
     d.maintain()
     assert not d.records()
     d.stop()
+
+
+def test_tracing_spans_record_metrics_and_parentage():
+    """Spans time into the metrics registry, know their parents, and the
+    import hot path produces a block_import > state_transition tree."""
+    from lighthouse_tpu.metrics import REGISTRY
+    from lighthouse_tpu.utils.tracing import current_span, span, traced
+
+    with span("outer") as outer:
+        assert current_span() is outer
+        with span("inner") as inner:
+            assert inner.parent is outer
+        assert current_span() is outer
+    assert current_span() is None
+    assert outer.duration_s is not None
+    assert REGISTRY.histogram("trace_span_seconds_outer").count >= 1
+
+    @traced("decorated_work")
+    def work():
+        return current_span().name
+
+    assert work() == "decorated_work"
+
+    # hot path integration: one imported block records both spans
+    from dataclasses import replace
+
+    from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    bls.set_backend("fake_crypto")
+    before = REGISTRY.histogram("trace_span_seconds_block_import").count
+    h = BeaconChainHarness(
+        replace(minimal_spec(), altair_fork_epoch=0), E, validator_count=8
+    )
+    h.extend_chain(2)
+    assert REGISTRY.histogram("trace_span_seconds_block_import").count >= before + 2
+    assert REGISTRY.histogram("trace_span_seconds_state_transition").count >= 2
+    assert REGISTRY.histogram("trace_span_seconds_fork_choice_on_block").count >= 2
