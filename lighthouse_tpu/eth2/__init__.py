@@ -89,6 +89,9 @@ class BeaconNodeHttpClient:
     def get_proposer_duties(self, epoch: int) -> list[dict]:
         return self._get(f"/eth/v1/validator/duties/proposer/{epoch}")["data"]
 
+    def get_block_rewards(self, block_id: str) -> dict:
+        return self._get(f"/eth/v1/beacon/rewards/blocks/{block_id}")["data"]
+
     # -- validator -------------------------------------------------------------
 
     def produce_block_ssz(self, slot: int, randao_reveal: bytes) -> bytes:

@@ -52,6 +52,12 @@ class WatchDB:
             "slot INTEGER PRIMARY KEY, best_guess TEXT, el_guess TEXT, "
             "graffiti TEXT)"
         )
+        # per-block proposer rewards (watch's block_rewards table)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS block_rewards ("
+            "slot INTEGER PRIMARY KEY, proposer INTEGER, total INTEGER, "
+            "attestations INTEGER, sync_aggregate INTEGER)"
+        )
         self._conn.commit()
 
     def record_gap(self, lo: int, hi: int):
@@ -198,6 +204,47 @@ class WatchDB:
             ).fetchall()
         return {guess: count for guess, count in rows}
 
+    def record_block_rewards(self, slot: int, rewards: dict):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO block_rewards VALUES (?, ?, ?, ?, ?)",
+                (
+                    slot,
+                    int(rewards["proposer_index"]),
+                    int(rewards["total"]),
+                    int(rewards["attestations"]),
+                    int(rewards["sync_aggregate"]),
+                ),
+            )
+            self._conn.commit()
+
+    def has_block_rewards(self, slot: int) -> bool:
+        with self._lock:
+            return (
+                self._conn.execute(
+                    "SELECT 1 FROM block_rewards WHERE slot = ?", (slot,)
+                ).fetchone()
+                is not None
+            )
+
+    def rewards_stats(self) -> dict:
+        """Aggregate proposer-reward analytics (watch's rewards queries)."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT COUNT(*), COALESCE(SUM(total), 0), "
+                "COALESCE(AVG(total), 0) FROM block_rewards"
+            ).fetchone()
+            per_proposer = self._conn.execute(
+                "SELECT proposer, SUM(total) FROM block_rewards "
+                "GROUP BY proposer"
+            ).fetchall()
+        return {
+            "blocks": row[0],
+            "total_gwei": int(row[1]),
+            "mean_gwei": round(row[2], 1),
+            "per_proposer": {str(p): int(t) for p, t in per_proposer},
+        }
+
     def suboptimal_attestation_count(self) -> int:
         with self._lock:
             return self._conn.execute(
@@ -212,6 +259,9 @@ class WatchUpdater:
         self.client = client
         self.db = db
         self.types = types
+        # rewards fetches that failed transiently: slot -> block root,
+        # retried on every update (a permanent 4xx drops the entry)
+        self._rewards_retry: dict[int, bytes] = {}
 
     def update(self) -> int:
         """Walk new canonical slots up to the node's head; returns how many
@@ -268,6 +318,14 @@ class WatchUpdater:
             slot = int(signed.message.slot)
             if self.db.blockprint_for_slot(slot) is None:
                 self.db.record_blockprint(slot, classify_block(signed))
+            if not self.db.has_block_rewards(slot):
+                # root already computed during the walk — no re-merkleize
+                self._fetch_rewards(slot, blocks_by_slot[slot][0])
+        for slot, root in list(self._rewards_retry.items()):
+            if self.db.has_block_rewards(slot):
+                self._rewards_retry.pop(slot, None)
+            else:
+                self._fetch_rewards(slot, root)
         fin = self.client.get_finality_checkpoints("head")
         self.db.record_finality(
             head_slot,
@@ -275,6 +333,26 @@ class WatchUpdater:
             int(fin["finalized"]["epoch"]),
         )
         return recorded
+
+    def _fetch_rewards(self, slot: int, root: bytes):
+        """Pull per-block rewards from the node. Permanent refusals (4xx:
+        pre-Altair block or parent state beyond the node's window) are
+        dropped; transient failures are queued for retry on the next
+        update so no silent permanent hole forms."""
+        import urllib.error
+
+        try:
+            self.db.record_block_rewards(
+                slot, self.client.get_block_rewards("0x" + root.hex())
+            )
+            self._rewards_retry.pop(slot, None)
+        except urllib.error.HTTPError as e:
+            if 400 <= e.code < 500:
+                self._rewards_retry.pop(slot, None)  # permanent: give up
+            else:
+                self._rewards_retry[slot] = root
+        except Exception:  # noqa: BLE001 — analytics must not wedge updates
+            self._rewards_retry[slot] = root
 
     def _record_packing(self, signed, blocks_by_slot):
         """Per-block packing + suboptimal-attestation analytics
@@ -328,6 +406,7 @@ class WatchServer(JsonHttpServer):
                     "/v1/packing": lambda: watch_db.packing_stats(),
                     "/v1/gaps": lambda: watch_db.gaps(),
                     "/v1/blockprint": lambda: watch_db.blockprint_shares(),
+                    "/v1/rewards": lambda: watch_db.rewards_stats(),
                 }
                 fn = routes.get(self.route)
                 if fn is None:

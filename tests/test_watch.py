@@ -80,6 +80,21 @@ def test_watch_packing_and_rest_server():
                 ).read()
             )
             assert missed == []
+            # per-block rewards pulled from the node's rewards route
+            rewards = json.loads(
+                urllib.request.urlopen(f"{base}/v1/rewards", timeout=5).read()
+            )
+            # every Altair block must yield rewards — a silent fetch hole
+            # would show here as a short count
+            assert rewards["blocks"] == E.SLOTS_PER_EPOCH + 2
+            assert rewards["total_gwei"] > 0
+            assert sum(rewards["per_proposer"].values()) == rewards["total_gwei"]
+            bp = json.loads(
+                urllib.request.urlopen(
+                    f"{base}/v1/blockprint", timeout=5
+                ).read()
+            )
+            assert sum(bp.values()) == E.SLOTS_PER_EPOCH + 2
         finally:
             ws.stop()
     finally:
