@@ -90,3 +90,107 @@ def compute_block_rewards(signed_block, pre_state, spec, E, types) -> dict:
         "proposer_slashings": str(rewards["proposer_slashings"]),
         "attester_slashings": str(rewards["attester_slashings"]),
     }
+
+
+def compute_attestation_rewards(state, spec, E, fork) -> dict:
+    """Per-validator attestation rewards for the state's PREVIOUS epoch —
+    the standard `/eth/v1/beacon/rewards/attestations/{epoch}` payload.
+    `state` must sit inside epoch(previous)+1 (its previous-epoch
+    participation is the requested epoch's), before the deltas apply.
+
+    Mirrors the altair flag-delta formulas (the same math the vectorized
+    epoch sweep applies), decomposed per flag + inactivity, plus the
+    ideal rewards per effective-balance tier."""
+    import numpy as np
+
+    from ..state_processing.altair import (
+        PARTICIPATION_FLAG_WEIGHTS,
+        TIMELY_HEAD_FLAG_INDEX,
+        TIMELY_SOURCE_FLAG_INDEX,
+        TIMELY_TARGET_FLAG_INDEX,
+        WEIGHT_DENOMINATOR,
+        attestation_flag_deltas,
+    )
+
+    # THE sweep's own computation — the endpoint cannot drift from the
+    # transition (state_processing/altair.py attestation_flag_deltas)
+    flag_rewards, flag_penalties, inactivity, eligible, info = (
+        attestation_flag_deltas(state, spec, E, fork)
+    )
+    flag_names = {
+        TIMELY_SOURCE_FLAG_INDEX: "source",
+        TIMELY_TARGET_FLAG_INDEX: "target",
+        TIMELY_HEAD_FLAG_INDEX: "head",
+    }
+    signed = {
+        flag_names[i]: flag_rewards[i].astype(np.int64)
+        - flag_penalties[i].astype(np.int64)
+        for i in range(len(PARTICIPATION_FLAG_WEIGHTS))
+    }
+
+    total_rewards = [
+        {
+            "validator_index": str(i),
+            "head": str(int(signed["head"][i])),
+            "target": str(int(signed["target"][i])),
+            "source": str(int(signed["source"][i])),
+            "inactivity": str(-int(inactivity[i])),
+        }
+        for i in np.nonzero(eligible)[0]
+    ]
+
+    # ideal rewards per effective-balance tier present in the registry
+    ideal = []
+    tai = info["total_active_increments"]
+    for inc in sorted(set(int(x) for x in info["eb_increments"][eligible])):
+        row = {"effective_balance": str(inc * E.EFFECTIVE_BALANCE_INCREMENT)}
+        base = inc * info["base_reward_per_increment"]
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            row[flag_names[flag_index]] = str(
+                0
+                if info["in_leak"]  # no flag rewards during a leak
+                else base * weight * info["upb_increments"][flag_index]
+                // (tai * WEIGHT_DENOMINATOR)
+            )
+        row["inactivity"] = "0"
+        ideal.append(row)
+    return {"ideal_rewards": ideal, "total_rewards": total_rewards}
+
+
+def compute_sync_committee_rewards(signed_block, pre_state, spec, E, types):
+    """Per-validator sync-committee rewards for `signed_block` — the
+    standard `/eth/v1/beacon/rewards/sync_committee/{block_id}` payload:
+    participants earn `participant_reward`, absent committee members
+    LOSE it (spec process_sync_aggregate). Returns a list of
+    {"validator_index": str, "reward": str} (reward may be negative),
+    one entry per committee position's validator (summed across
+    duplicate positions)."""
+    from ..state_processing.altair import sync_participant_reward
+    from ..state_processing.per_block import _validator_index_by_pubkey
+
+    block = signed_block.message
+    body = block.body
+    aggregate = getattr(body, "sync_aggregate", None)
+    if aggregate is None:
+        raise ValueError("pre-Altair block has no sync aggregate")
+    state = pre_state.copy()
+    while state.slot < block.slot:
+        per_slot_processing(state, spec, E)
+
+    # the transition's own formula (process_sync_aggregate)
+    participant_reward = sync_participant_reward(state, E)
+
+    deltas: dict[int, int] = {}
+    for pk, bit in zip(
+        state.current_sync_committee.pubkeys, aggregate.sync_committee_bits
+    ):
+        index = _validator_index_by_pubkey(state, bytes(pk))
+        if index is None:
+            raise ValueError("sync committee pubkey not in registry")
+        deltas[index] = deltas.get(index, 0) + (
+            participant_reward if bit else -participant_reward
+        )
+    return [
+        {"validator_index": str(i), "reward": str(d)}
+        for i, d in sorted(deltas.items())
+    ]

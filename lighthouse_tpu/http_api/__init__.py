@@ -388,6 +388,98 @@ class BeaconApi:
             "finalized": False,
         }
 
+    def _resolve_validator_ids(self, state, validator_ids) -> set[str]:
+        """Spec ValidatorId = index | pubkey → set of index strings."""
+        wanted = set()
+        by_pubkey = None
+        for v in validator_ids:
+            v = str(v)
+            if v.isdigit():
+                wanted.add(v)
+                continue
+            if by_pubkey is None:
+                by_pubkey = {
+                    _hex(val.pubkey): str(i)
+                    for i, val in enumerate(state.validators)
+                }
+            idx = by_pubkey.get(v.lower())
+            if idx is not None:
+                wanted.add(idx)
+        return wanted
+
+    def attestation_rewards(self, epoch: int, validator_ids=None):
+        """POST /eth/v1/beacon/rewards/attestations/{epoch}: per-validator
+        flag/inactivity deltas for attestations made in `epoch`, computed
+        from the canonical state at the end of epoch+1 (before the epoch
+        transition applies them)."""
+        from ..beacon_chain.rewards import compute_attestation_rewards
+        from ..state_processing import per_slot_processing
+
+        chain = self.chain
+        E = chain.E
+        epoch = int(epoch)
+        target_slot = (epoch + 2) * E.SLOTS_PER_EPOCH - 1
+        if target_slot > int(chain.head_state.slot):
+            raise ApiError(
+                404, f"rewards for epoch {epoch} not yet computable"
+            )
+        anc = chain.fork_choice.proto.proto_array.ancestor_at_slot(
+            chain.head_root, target_slot
+        )
+        if anc is None:
+            raise ApiError(404, "canonical ancestor unavailable")
+        st = chain.state_for_block_root(anc)
+        if st is None:
+            raise ApiError(404, "state unavailable for reward computation")
+        st = st.copy()
+        while st.slot < target_slot:
+            per_slot_processing(st, chain.spec, E)
+        fork = chain.types.fork_of_state(st)
+        from ..types.chain_spec import ForkName
+
+        if fork < ForkName.ALTAIR:
+            raise ApiError(400, "attestation rewards are Altair+")
+        data = compute_attestation_rewards(st, chain.spec, E, fork)
+        if validator_ids:
+            wanted = self._resolve_validator_ids(st, validator_ids)
+            data["total_rewards"] = [
+                e
+                for e in data["total_rewards"]
+                if e["validator_index"] in wanted
+            ]
+        return {
+            "data": data,
+            "execution_optimistic": False,
+            "finalized": False,
+        }
+
+    def sync_committee_rewards(self, block_id: str, validator_ids=None):
+        """POST /eth/v1/beacon/rewards/sync_committee/{block_id}: per-
+        validator sync rewards (negative for absent members)."""
+        from ..beacon_chain.rewards import compute_sync_committee_rewards
+
+        root, signed = self._block(block_id)
+        chain = self.chain
+        parent_state = chain.state_for_block_root(
+            bytes(signed.message.parent_root)
+        )
+        if parent_state is None:
+            raise ApiError(404, "parent state unavailable for reward replay")
+        try:
+            data = compute_sync_committee_rewards(
+                signed, parent_state, chain.spec, chain.E, chain.types
+            )
+        except ValueError as e:
+            raise ApiError(400, str(e)) from e
+        if validator_ids:
+            wanted = self._resolve_validator_ids(parent_state, validator_ids)
+            data = [e for e in data if e["validator_index"] in wanted]
+        return {
+            "data": data,
+            "execution_optimistic": False,
+            "finalized": False,
+        }
+
     def block_header(self, block_id: str):
         root, signed = self._block(block_id)
         m = signed.message
@@ -1140,6 +1232,25 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 code = self.api.publish_voluntary_exit_ssz(body)
                 self._send_json({"code": code, "message": "ok"}, code)
+                return
+            m = re.match(
+                r"^/eth/v1/beacon/rewards/sync_committee/(?P<block_id>[^/]+)$",
+                path,
+            )
+            if m:
+                ids = json.loads(body) if body else None
+                self._send_json(
+                    self.api.sync_committee_rewards(m.group("block_id"), ids)
+                )
+                return
+            m = re.match(
+                r"^/eth/v1/beacon/rewards/attestations/(?P<epoch>\d+)$", path
+            )
+            if m:
+                ids = json.loads(body) if body else None
+                self._send_json(
+                    self.api.attestation_rewards(int(m.group("epoch")), ids)
+                )
                 return
             if path == "/eth/v1/beacon/pool/proposer_slashings":
                 code = self.api.publish_proposer_slashing_ssz(body)

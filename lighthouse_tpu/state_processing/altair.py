@@ -276,6 +276,25 @@ def sync_aggregate_signature_set(
     )
 
 
+def sync_participant_reward(state, E) -> int:
+    """Per-participant sync-committee reward for one slot (spec
+    process_sync_aggregate). Shared by the transition and the rewards
+    API so the endpoint reports exactly what the transition credits."""
+    total_active_increments = (
+        get_total_active_balance(state, E) // E.EFFECTIVE_BALANCE_INCREMENT
+    )
+    total_base_rewards = (
+        get_base_reward_per_increment(state, E) * total_active_increments
+    )
+    max_participant_rewards = (
+        total_base_rewards
+        * SYNC_REWARD_WEIGHT
+        // WEIGHT_DENOMINATOR
+        // E.SLOTS_PER_EPOCH
+    )
+    return max_participant_rewards // E.SYNC_COMMITTEE_SIZE
+
+
 def process_sync_aggregate(
     state, sync_aggregate, spec: ChainSpec, E, verify_signatures: bool, ctxt
 ):
@@ -303,19 +322,7 @@ def process_sync_aggregate(
             raise BlockProcessingError("sync aggregate: invalid signature")
 
     # Rewards (sync_committee.rs / spec process_sync_aggregate)
-    total_active_increments = (
-        get_total_active_balance(state, E) // E.EFFECTIVE_BALANCE_INCREMENT
-    )
-    total_base_rewards = (
-        get_base_reward_per_increment(state, E) * total_active_increments
-    )
-    max_participant_rewards = (
-        total_base_rewards
-        * SYNC_REWARD_WEIGHT
-        // WEIGHT_DENOMINATOR
-        // E.SLOTS_PER_EPOCH
-    )
-    participant_reward = max_participant_rewards // E.SYNC_COMMITTEE_SIZE
+    participant_reward = sync_participant_reward(state, E)
     proposer_reward = (
         participant_reward * PROPOSER_WEIGHT // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
     )
@@ -467,18 +474,24 @@ def process_inactivity_updates(
     state.inactivity_scores[:] = scores.tolist()
 
 
-def process_rewards_and_penalties_altair(
+def attestation_flag_deltas(
     state, spec: ChainSpec, E, fork: ForkName, arrays: EpochArrays | None = None
 ):
-    """Flag deltas + inactivity penalties as fused array ops
-    (single_pass.rs:20 / altair/beacon-chain.md get_flag_index_deltas)."""
-    from ..types.chain_spec import GENESIS_EPOCH
+    """Per-validator attestation reward/penalty components for the
+    PREVIOUS epoch (altair/beacon-chain.md get_flag_index_deltas +
+    get_inactivity_penalty_deltas), as unsigned numpy arrays. The epoch
+    sweep applies them; the rewards API reports them — one
+    implementation, so the endpoint can never drift from the transition.
+
+    Returns (flag_rewards, flag_penalties, inactivity_penalties,
+    eligible, info): per-flag lists of uint64 arrays, the inactivity
+    penalty array, the eligibility mask, and an `info` dict
+    (base_reward_per_increment, total_active_increments,
+    upb_increments[flag], in_leak) for ideal-reward reporting."""
     from .per_epoch import get_finality_delay
 
-    current = get_current_epoch(state, E)
-    if current == GENESIS_EPOCH:
-        return
     arrays = arrays or EpochArrays(state, E)
+    current = get_current_epoch(state, E)
     previous = get_previous_epoch(state, E)
     prev_active = arrays.active_at(previous)
     curr_active = arrays.active_at(current)
@@ -500,8 +513,9 @@ def process_rewards_and_penalties_altair(
     total_active_increments = total_active // E.EFFECTIVE_BALANCE_INCREMENT
 
     in_leak = get_finality_delay(state, E) > E.MIN_EPOCHS_TO_INACTIVITY_PENALTY
-    rewards = np.zeros(arrays.n, dtype=np.uint64)
-    penalties = np.zeros(arrays.n, dtype=np.uint64)
+    flag_rewards: list[np.ndarray] = []
+    flag_penalties: list[np.ndarray] = []
+    upb_increments_by_flag: list[int] = []
 
     for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
         participating = (
@@ -512,7 +526,10 @@ def process_rewards_and_penalties_altair(
             E.EFFECTIVE_BALANCE_INCREMENT,
         )
         upb_increments = upb // E.EFFECTIVE_BALANCE_INCREMENT
+        upb_increments_by_flag.append(upb_increments)
         got_flag = eligible & participating
+        reward = np.zeros(arrays.n, dtype=np.uint64)
+        penalty = np.zeros(arrays.n, dtype=np.uint64)
         if not in_leak:
             # reward = base * weight * upi // (tai * WD)
             numer = (
@@ -520,14 +537,16 @@ def process_rewards_and_penalties_altair(
                 * np.uint64(weight)
                 * np.uint64(upb_increments)
             )
-            rewards[got_flag] += numer // np.uint64(
+            reward[got_flag] = numer // np.uint64(
                 total_active_increments * WEIGHT_DENOMINATOR
             )
         if flag_index != TIMELY_HEAD_FLAG_INDEX:
             missed = eligible & ~participating
-            penalties[missed] += (
+            penalty[missed] = (
                 base_rewards[missed] * np.uint64(weight)
             ) // np.uint64(WEIGHT_DENOMINATOR)
+        flag_rewards.append(reward)
+        flag_penalties.append(penalty)
 
     # Inactivity penalties (get_inactivity_penalty_deltas)
     scores = np.fromiter(state.inactivity_scores, dtype=np.uint64, count=arrays.n)
@@ -541,6 +560,7 @@ def process_rewards_and_penalties_altair(
     )
     inactive = eligible & ~participating_target
     denom = spec.inactivity_score_bias * quotient
+    inactivity = np.zeros(arrays.n, dtype=np.uint64)
     max_score = int(scores.max(initial=0))
     max_eb = int(arrays.effective_balance.max(initial=0))
     if max_score and max_eb and max_score > (1 << 64) // max_eb:
@@ -549,12 +569,42 @@ def process_rewards_and_penalties_altair(
         # bigint math for the affected lanes instead of aborting the node
         # (r2 advisor finding — the guard used to be a bare assert).
         for i in np.nonzero(inactive)[0]:
-            penalties[i] += np.uint64(
+            inactivity[i] = np.uint64(
                 int(arrays.effective_balance[i]) * int(scores[i]) // denom
             )
     else:
         penalty_numer = arrays.effective_balance[inactive] * scores[inactive]
-        penalties[inactive] += penalty_numer // np.uint64(denom)
+        inactivity[inactive] = penalty_numer // np.uint64(denom)
+
+    info = {
+        "base_reward_per_increment": base_reward_per_increment,
+        "total_active_increments": total_active_increments,
+        "upb_increments": upb_increments_by_flag,
+        "in_leak": in_leak,
+        "eb_increments": eb_increments,
+    }
+    return flag_rewards, flag_penalties, inactivity, eligible, info
+
+
+def process_rewards_and_penalties_altair(
+    state, spec: ChainSpec, E, fork: ForkName, arrays: EpochArrays | None = None
+):
+    """Flag deltas + inactivity penalties as fused array ops
+    (single_pass.rs:20 / altair/beacon-chain.md get_flag_index_deltas)."""
+    from ..types.chain_spec import GENESIS_EPOCH
+
+    current = get_current_epoch(state, E)
+    if current == GENESIS_EPOCH:
+        return
+    arrays = arrays or EpochArrays(state, E)
+    flag_rewards, flag_penalties, inactivity, _eligible, _info = (
+        attestation_flag_deltas(state, spec, E, fork, arrays)
+    )
+    rewards = np.zeros(arrays.n, dtype=np.uint64)
+    penalties = inactivity.copy()
+    for reward, penalty in zip(flag_rewards, flag_penalties):
+        rewards += reward
+        penalties += penalty
 
     balances = np.fromiter(state.balances, dtype=np.uint64, count=arrays.n)
     balances += rewards
