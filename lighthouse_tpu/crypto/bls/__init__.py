@@ -373,22 +373,53 @@ class _FakeBackend:
 
 
 class _TpuBackend(_HostBackend):
-    """Host ops with device-batched batch verification (ops/bls381).
-
-    The RLC scalar multiplications (the MSM over signature sets) run on
-    device; the final multi-pairing runs on host until the pairing kernel
-    lands. Falls back to host behavior transparently."""
+    """Host ops with FULL device batch verification (ops/bls381_verify):
+    subgroup checks, committee aggregation, RLC ladders, SSWU hash-to-G2
+    and the multi-pairing all on device. Batches are processed in
+    bounded-shape chunks (LIGHTHOUSE_TPU_BLS_CHUNK, default 128) so
+    kernel compiles stay minutes, not hours, and the compile cache is
+    reused across batch sizes. Falls back — loudly, once — to the
+    partial device path (RLC scalar-muls + host pairing, ops/bls381) and
+    then to pure host on failure."""
 
     name = "tpu"
+    _warned = False
 
     def verify_signature_sets(self, sets, rng=None) -> bool:
+        import os as _os
+
+        sets = list(sets)
+        if not sets:
+            return super().verify_signature_sets(sets, rng)
         try:
             from ...ops import bls381 as device
         except Exception:
             device = None
         if device is None or not getattr(device, "AVAILABLE", False):
             return super().verify_signature_sets(sets, rng)
-        return device.verify_signature_sets_device(sets, rng)
+        try:
+            from ...ops.bls381_verify import verify_signature_sets_device_full
+
+            chunk = int(
+                _os.environ.get("LIGHTHOUSE_TPU_BLS_CHUNK", "128")
+            ) or len(sets)
+            for i in range(0, len(sets), chunk):
+                if not verify_signature_sets_device_full(
+                    sets[i:i + chunk], rng
+                ):
+                    return False
+            return True
+        except Exception as e:  # noqa: BLE001 — e.g. remote-compile failure
+            if not _TpuBackend._warned:
+                _TpuBackend._warned = True
+                from ...utils.logging import get_logger
+
+                get_logger("lighthouse_tpu.bls").warning(
+                    "full device BLS path failed; falling back to the "
+                    "partial device path",
+                    error=str(e)[:200],
+                )
+            return device.verify_signature_sets_device(sets, rng)
 
 
 _BACKENDS = {
