@@ -1066,13 +1066,17 @@ class BeaconChain:
 
 
 def _make_persistent(state):
-    """Swap big uint64 list fields to PersistentList in place."""
-    from ..ssz.persistent import PersistentList
+    """Swap registry-scale list fields to persistent (structurally-shared)
+    lists in place — the tree-states backbone (beacon_state.rs:34,371)."""
+    from ..ssz.persistent import PersistentContainerList, PersistentList
 
     for fname in ("balances", "inactivity_scores"):
         v = getattr(state, fname, None)
         if isinstance(v, list):
             object.__setattr__(state, fname, PersistentList(v))
+    v = getattr(state, "validators", None)
+    if isinstance(v, list):
+        object.__setattr__(state, "validators", PersistentContainerList(v))
 
 
 def empty_sync_aggregate(types, E):

@@ -54,53 +54,58 @@ def _block_type_for(data: bytes, E):
 
 
 def cmd_beacon_node(args):
-    """Run an in-process dev beacon node: interop genesis, mock EL, HTTP
-    API, per-slot timer, optional self-validating keypairs (the local
-    dev-chain loop; production networking lands with the p2p stack)."""
+    """Run a beacon node assembled by ClientBuilder (the builder.rs:109-787
+    analog): store → genesis (interop or checkpoint state) → chain → mock
+    EL → network service → HTTP API → state-advance timer → optional VC /
+    slasher. The dev chain self-validates with interop keys; production
+    networking peers over --network-port."""
     import time
 
-    from .beacon_chain.harness import BeaconChainHarness
-    from .beacon_chain.timer import SlotTimer
-    from .crypto import bls
-    from .http_api import HttpApiServer
+    from .client import ClientBuilder, ClientConfig
     from .utils.logging import get_logger
-    from .validator_client import ValidatorClient
 
     log = get_logger("lighthouse_tpu.bn")
-    bls.set_backend("fake_crypto" if args.fake_crypto else "host")
     spec, E = _load_spec(args.spec)
     from dataclasses import replace
 
     spec = replace(spec, altair_fork_epoch=0, seconds_per_slot=args.seconds_per_slot)
-    h = BeaconChainHarness(
-        spec, E, validator_count=args.validators, mock_execution_layer=True
+    backend = "fake_crypto" if args.fake_crypto else args.bls_backend
+    checkpoint_state = None
+    if args.checkpoint_state:
+        checkpoint_state = _state_type_for(
+            open(args.checkpoint_state, "rb").read(), E
+        )
+    cfg = ClientConfig(
+        spec=spec,
+        E=E,
+        db_path=args.db_path,
+        db_backend=args.db_backend,
+        http_port=args.http_port,
+        network_port=args.network_port,
+        noise=args.noise,
+        validator_count=args.validators,
+        validate=args.validate and checkpoint_state is None,
+        manual_slot_clock=False,
+        genesis_state=checkpoint_state,
+        slasher=args.slasher,
+        bls_backend=backend,
     )
-    vc = ValidatorClient(h.chain, h.keypairs, spec, E) if args.validate else None
-    server = HttpApiServer(h.chain, port=args.http_port).start()
-    log.info("beacon node up", http_port=server.port, validators=args.validators)
-
-    def on_slot(slot):
-        h.slot_clock.set_slot(slot)  # no-op for system clock; manual in tests
-        if vc is not None:
-            root = vc.on_slot(slot)
-            log.info(
-                "slot processed",
-                slot=slot,
-                head=h.chain.head_root.hex()[:12],
-                proposed=bool(root),
-                finalized_epoch=h.finalized_epoch,
-            )
-
-    timer = SlotTimer(h.slot_clock, on_slot)
+    client = ClientBuilder(cfg).build().start()
+    log.info(
+        "beacon node up",
+        http_port=client.http_server.port if client.http_server else None,
+        network_port=client.network.port if client.network else None,
+        validators=args.validators,
+        bls_backend=backend,
+    )
     deadline = time.time() + args.run_for if args.run_for else None
     try:
         while deadline is None or time.time() < deadline:
-            timer.tick()
             time.sleep(min(1.0, spec.seconds_per_slot / 4))
     except KeyboardInterrupt:
         pass
     finally:
-        server.stop()
+        client.stop()
     return 0
 
 
@@ -444,12 +449,33 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sub = p.add_subparsers(dest="command", required=True)
 
-    bn = sub.add_parser("bn", help="run a dev beacon node")
+    bn = sub.add_parser("bn", help="run a beacon node (ClientBuilder-assembled)")
     bn.add_argument("--validators", type=int, default=16)
     bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--network-port", type=int, default=0, help="0 = ephemeral")
+    bn.add_argument("--noise", action="store_true", help="Noise-XX p2p streams")
     bn.add_argument("--seconds-per-slot", type=int, default=12)
     bn.add_argument("--validate", action="store_true", help="run an in-process VC")
-    bn.add_argument("--fake-crypto", action="store_true")
+    bn.add_argument(
+        "--bls-backend",
+        choices=["host", "tpu", "fake_crypto"],
+        default="host",
+        help="crypto backend seam (crypto/bls/src/lib.rs:84-139); tpu = "
+        "device batch verification + device epoch sweep",
+    )
+    bn.add_argument(
+        "--fake-crypto", action="store_true",
+        help="shorthand for --bls-backend fake_crypto",
+    )
+    bn.add_argument("--db-path", default=None, help="persist chain data here")
+    bn.add_argument(
+        "--db-backend", choices=["auto", "native", "sqlite"], default="auto"
+    )
+    bn.add_argument(
+        "--checkpoint-state", default=None,
+        help="SSZ BeaconState file to boot from (checkpoint sync)",
+    )
+    bn.add_argument("--slasher", action="store_true")
     bn.add_argument("--run-for", type=float, default=None, help="seconds then exit")
     bn.set_defaults(fn=cmd_beacon_node)
 

@@ -41,6 +41,12 @@ class ClientConfig:
     genesis_state: object = None  # checkpoint-sync style provided state
     genesis_time: int = 1_600_000_000
     slasher: bool = False  # run the in-process slashing detector
+    # BLS backend the node runs with (crypto/bls/src/lib.rs:84-139 seam):
+    # host | tpu | fake_crypto. "tpu" routes every batch verification
+    # through ops/bls381_verify on the live JAX device and turns the
+    # device epoch sweep on by default (LIGHTHOUSE_TPU_DEVICE_EPOCH_SWEEP
+    # still overrides either way).
+    bls_backend: str = "host"
 
 
 class Client:
@@ -67,11 +73,20 @@ class Client:
         return self
 
     def on_slot(self, slot: int):
-        """Manual-clock driving (tests / simulator)."""
+        """Per-slot tick (timer-driven, or manual in tests/simulator)."""
         if isinstance(self.slot_clock, ManualSlotClock):
             self.slot_clock.set_slot(slot)
         if self.vc is not None:
-            self.vc.on_slot(slot)
+            proposed = self.vc.on_slot(slot)
+            log.info(
+                "slot processed",
+                slot=slot,
+                head=self.chain.head_root.hex()[:12],
+                proposed=bool(proposed),
+                finalized_epoch=int(
+                    self.chain.head_state.finalized_checkpoint.epoch
+                ),
+            )
         if self.state_advance is not None:
             # pre-build next slot's state off the (possibly new) head
             self.state_advance.on_slot_tick(slot)
@@ -101,6 +116,15 @@ class ClientBuilder:
     def build(self) -> Client:
         cfg = self.config
         c = self.client
+        # crypto backend: the node-level seam selection (the reference picks
+        # its backend at compile time, lib.rs:84-139; here it's runtime)
+        bls.set_backend(cfg.bls_backend)
+        if cfg.bls_backend == "tpu":
+            import os
+
+            # device epoch sweep rides the same device the verifier uses;
+            # an explicit env setting (incl. "0") wins
+            os.environ.setdefault("LIGHTHOUSE_TPU_DEVICE_EPOCH_SWEEP", "1")
         # store
         if cfg.db_path:
             store = HotColdDB(open_item_store(cfg.db_path, cfg.db_backend))

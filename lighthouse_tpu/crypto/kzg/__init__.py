@@ -13,10 +13,14 @@ the barycentric formula, and quotients are computed pointwise on the domain
 (no FFT on the hot path). A radix-2 NTT over Fr is provided for
 monomial↔evaluation conversions (`fft_fr`).
 
-Trusted setup: the standard JSON format loads via `TrustedSetup.from_json`
-(the mainnet ceremony file is not shipped here — zero-egress image; point
-`LIGHTHOUSE_TPU_TRUSTED_SETUP` at one to use it). Tests and the dev chain
-use `TrustedSetup.insecure_dev(n)` — a deterministic tau (NOT secret, never
+Trusted setup: the standard JSON format loads via `TrustedSetup.from_json`.
+The mainnet ceremony output ships beside this file as `trusted_setup.json`
+(byte-identical to the reference's copy at common/eth2_network_config/
+built_in_network_configs/trusted_setup.json — both are the published output
+of the public EIP-4844 KZG ceremony, a constants table that must be
+bit-exact to be correct) and `TrustedSetup.default()` loads it; set
+`LIGHTHOUSE_TPU_TRUSTED_SETUP` to override. Tests and the dev chain use
+`TrustedSetup.insecure_dev(n)` — a deterministic tau (NOT secret, never
 for production) that yields a fully functional scheme. Generated setups are
 disk-cached under .jax_cache (uncompressed affine ints; instant reload).
 """
@@ -298,29 +302,185 @@ def _g1_msm(scalars: list[int], points: list, window: int = 8) -> tuple:
 
 
 # ---------------------------------------------------------------------------
+# Device offload (SURVEY §2.7-2: KZG rides the MSM/pairing kernels)
+# ---------------------------------------------------------------------------
+
+
+class _DeviceKzg:
+    """Lazy per-setup device residency: the Lagrange points and the
+    bit-reversed domain live on device across calls; kernels come from
+    ops/{fr,msm,bls381_pairing}. Any failure marks the context dead and
+    the Kzg engine falls back to host (loudly, once)."""
+
+    def __init__(self, setup: TrustedSetup):
+        self.setup = setup
+        self._points = None
+        self._roots = None
+        self.log_n = (setup.n - 1).bit_length()
+        if (1 << self.log_n) != setup.n:
+            raise KzgError("device KZG requires a power-of-two domain")
+
+    @property
+    def points(self):
+        if self._points is None:
+            from ...ops.bls381 import g1_points_to_device
+
+            self._points = g1_points_to_device(self.setup.g1_lagrange)
+        return self._points
+
+    @property
+    def roots(self):
+        if self._roots is None:
+            import jax.numpy as jnp
+
+            from ...ops.fr import fr_to_device
+
+            self._roots = jnp.asarray(fr_to_device(self.setup.roots_brp))
+        return self._roots
+
+    def evaluate_batch(self, evals_lists: list[list[int]], zs: list[int]) -> list[int]:
+        """[p_j(z_j)] — callers guarantee no z hits a domain point."""
+        import jax.numpy as jnp
+
+        from ...ops.fr import barycentric_eval_batch, fr_from_device, fr_to_device
+
+        m = len(evals_lists)
+        # pad the blob axis to a power-of-two bucket: few compiled shapes
+        mb = 1
+        while mb < m:
+            mb *= 2
+        padded = list(evals_lists) + [evals_lists[0]] * (mb - m)
+        zs_p = list(zs) + [zs[0]] * (mb - m)
+        ev = jnp.asarray(
+            np.stack([fr_to_device(e) for e in padded])
+        )
+        z_dev = jnp.asarray(fr_to_device(zs_p))
+        ys = barycentric_eval_batch(ev, self.roots, z_dev, self.log_n)
+        return fr_from_device(ys)[:m]
+
+    def msm(self, scalars: list[int]):
+        from ...ops.msm import g1_msm_device
+
+        return g1_msm_device(scalars, self.points)
+
+    def quotient(self, evals: list[int], z: int, y: int) -> list[int]:
+        import jax.numpy as jnp
+
+        from ...ops.fr import fr_from_device, fr_to_device, quotient_batch
+
+        q = quotient_batch(
+            jnp.asarray(fr_to_device(evals)),
+            self.roots,
+            jnp.asarray(fr_to_device([z]))[0],
+            jnp.asarray(fr_to_device([y]))[0],
+        )
+        return fr_from_device(q)
+
+    def pairing_check(self, pairs) -> bool:
+        """∏ e(Pᵢ, Qᵢ) == 1 with the Miller loops + final exp on device.
+        pairs: host (G1 Jacobian, G2 Jacobian) tuples."""
+        from ...ops.bls381_pairing import (
+            g1_affine_to_device,
+            g2_affine_to_device,
+            multi_pairing_check_device,
+        )
+
+        g1_aff, g2_aff = [], []
+        for p, q in pairs:
+            pa = to_affine(FQ, p)
+            qa = to_affine(FQ2, q)
+            g1_aff.append(None if pa is None else pa)
+            g2_aff.append(None if qa is None else qa)
+        xp, yp, p_inf = g1_affine_to_device(g1_aff)
+        qx, qy, q_inf = g2_affine_to_device(g2_aff)
+        return bool(multi_pairing_check_device(xp, yp, p_inf, qx, qy, q_inf))
+
+
+import numpy as np  # noqa: E402  (host-side packing for the device path)
+
+
+# ---------------------------------------------------------------------------
 # The Kzg engine (crypto/kzg/src/lib.rs:35 `Kzg` analog)
 # ---------------------------------------------------------------------------
 
 
 class Kzg:
-    def __init__(self, setup: TrustedSetup | None = None):
+    def __init__(self, setup: TrustedSetup | None = None, device: bool | None = None):
         self.setup = setup if setup is not None else TrustedSetup.default()
+        if device is None:
+            device = os.environ.get("LIGHTHOUSE_TPU_DEVICE_KZG") == "1"
+        self._dev: _DeviceKzg | None = None
+        self._dev_warned = False
+        if device:
+            try:
+                self._dev = _DeviceKzg(self.setup)
+            except Exception:
+                self._dev = None
+
+    def _device_call(self, fn, *args):
+        """Run a device-path closure; on failure, disable the device path
+        (loudly, once) and return None so callers fall back to host."""
+        if self._dev is None:
+            return None
+        try:
+            return fn(self._dev, *args)
+        except Exception as e:  # noqa: BLE001 — e.g. remote-compile failure
+            if not self._dev_warned:
+                self._dev_warned = True
+                from ...utils.logging import get_logger
+
+                get_logger("lighthouse_tpu.kzg").warning(
+                    "device KZG path failed; falling back to host",
+                    error=str(e)[:200],
+                )
+            self._dev = None
+            return None
 
     # -- commitments ----------------------------------------------------------
 
     def blob_to_kzg_commitment(self, blob: bytes) -> bytes:
         evals = _blob_to_evals(blob, self.setup.n)
-        return g1_to_bytes(_g1_msm(evals, self.setup.g1_lagrange))
+        pt = self._device_call(lambda d: d.msm(evals))
+        if pt is None:
+            pt = _g1_msm(evals, self.setup.g1_lagrange)
+        return g1_to_bytes(pt)
 
     # -- openings -------------------------------------------------------------
 
     def _evaluate(self, evals: list[int], z: int) -> int:
         """p(z) by the barycentric formula on the bit-reversed domain."""
+        return self._evaluate_many([evals], [z])[0]
+
+    def _evaluate_many(self, evals_lists: list[list[int]], zs: list[int]) -> list[int]:
+        """Batch p_j(z_j) — one fused device kernel when available.
+        Domain hits are answered directly (both paths)."""
+        roots = self.setup.roots_brp
+        root_pos = {w: i for i, w in enumerate(roots)}
+        out: list[int | None] = []
+        pending: list[int] = []
+        for j, z in enumerate(zs):
+            hit = root_pos.get(z)
+            out.append(evals_lists[j][hit] if hit is not None else None)
+            if hit is None:
+                pending.append(j)
+        if pending:
+            dev = self._device_call(
+                lambda d: d.evaluate_batch(
+                    [evals_lists[j] for j in pending],
+                    [zs[j] for j in pending],
+                )
+            )
+            if dev is not None:
+                for j, y in zip(pending, dev):
+                    out[j] = y
+            else:
+                for j in pending:
+                    out[j] = self._evaluate_host(evals_lists[j], zs[j])
+        return out
+
+    def _evaluate_host(self, evals: list[int], z: int) -> int:
         n = self.setup.n
         roots = self.setup.roots_brp
-        for i, w in enumerate(roots):
-            if z == w:
-                return evals[i]
         # p(z) = (z^n - 1)/n · Σ p_i·w_i/(z - w_i)
         total = 0
         for p_i, w_i in zip(evals, roots):
@@ -342,17 +502,20 @@ class Kzg:
         y = self._evaluate(evals, z)
         roots = self.setup.roots_brp
         n = self.setup.n
-        q = [0] * n
-        hit = None
-        for i, w_i in enumerate(roots):
-            if w_i == z:
-                hit = i
-                continue
-            q[i] = (
-                (evals[i] - y)
-                * pow((w_i - z) % FR_MODULUS, FR_MODULUS - 2, FR_MODULUS)
-                % FR_MODULUS
-            )
+        hit = next((i for i, w in enumerate(roots) if w == z), None)
+        q = None
+        if hit is None:
+            q = self._device_call(lambda d: d.quotient(evals, z, y))
+        if q is None:
+            q = [0] * n
+            for i, w_i in enumerate(roots):
+                if w_i == z:
+                    continue
+                q[i] = (
+                    (evals[i] - y)
+                    * pow((w_i - z) % FR_MODULUS, FR_MODULUS - 2, FR_MODULUS)
+                    % FR_MODULUS
+                )
         if hit is not None:
             # q_hit = Σ_{j≠hit} (p_j - y)·w_j / (w_hit·(w_hit - w_j))
             w_h = roots[hit]
@@ -364,7 +527,9 @@ class Kzg:
                 den = w_h * ((w_h - w_j) % FR_MODULUS) % FR_MODULUS
                 acc = (acc + num * pow(den, FR_MODULUS - 2, FR_MODULUS)) % FR_MODULUS
             q[hit] = acc
-        proof = _g1_msm(q, self.setup.g1_lagrange)
+        proof = self._device_call(lambda d: d.msm(q))
+        if proof is None:
+            proof = _g1_msm(q, self.setup.g1_lagrange)
         return g1_to_bytes(proof), _fr_to_bytes(y)
 
     def verify_kzg_proof(
@@ -381,9 +546,9 @@ class Kzg:
             self.setup.g2_monomial[1],
             pt_neg(FQ2, pt_mul(FQ2, G2_GEN, z)),
         )
-        return pairing_check(
-            [(pt_neg(FQ, c_minus_y), G2_GEN), (pi, tau_minus_z)]
-        )
+        pairs = [(pt_neg(FQ, c_minus_y), G2_GEN), (pi, tau_minus_z)]
+        dev = self._device_call(lambda d: d.pairing_check(pairs))
+        return dev if dev is not None else pairing_check(pairs)
 
     # -- blob proofs (EIP-4844 fiat-shamir) ------------------------------------
 
@@ -412,6 +577,10 @@ class Kzg:
         y = self._evaluate(evals, _fr_from_bytes(z))
         return self.verify_kzg_proof(commitment, z, _fr_to_bytes(y), proof)
 
+    def verify_blob_kzg_proof_device_stats(self) -> dict:
+        """Observability: whether the device path is live (node metrics)."""
+        return {"device": self._dev is not None}
+
     def verify_blob_kzg_proof_batch(
         self, blobs: list[bytes], commitments: list[bytes], proofs: list[bytes]
     ) -> bool:
@@ -424,14 +593,15 @@ class Kzg:
             return True
         if len(blobs) == 1:
             return self.verify_blob_kzg_proof(blobs[0], commitments[0], proofs[0])
-        zs, ys, c_pts, pi_pts = [], [], [], []
+        zs, c_pts, pi_pts, evals_lists = [], [], [], []
         for blob, commitment, proof in zip(blobs, commitments, proofs):
             z = self._blob_challenge(blob, commitment)
-            evals = _blob_to_evals(blob, self.setup.n)
+            evals_lists.append(_blob_to_evals(blob, self.setup.n))
             zs.append(_fr_from_bytes(z))
-            ys.append(self._evaluate(evals, _fr_from_bytes(z)))
             c_pts.append(g1_from_bytes(commitment))
             pi_pts.append(g1_from_bytes(proof))
+        # all evaluations in one fused device kernel (host fallback inside)
+        ys = self._evaluate_many(evals_lists, zs)
         # spec verify_kzg_proof_batch: one r from the transcript, scalars are
         # its powers (polynomial-commitments.md; c-kzg byte-exact layout)
         data = (
@@ -451,9 +621,9 @@ class Kzg:
             term = pt_add(FQ, term, pt_mul(FQ, pi, z))
             lhs = pt_add(FQ, lhs, pt_mul(FQ, term, r))
             rhs = pt_add(FQ, rhs, pt_mul(FQ, pi, r))
-        return pairing_check(
-            [(pt_neg(FQ, lhs), G2_GEN), (rhs, self.setup.g2_monomial[1])]
-        )
+        pairs = [(pt_neg(FQ, lhs), G2_GEN), (rhs, self.setup.g2_monomial[1])]
+        dev = self._device_call(lambda d: d.pairing_check(pairs))
+        return dev if dev is not None else pairing_check(pairs)
 
 
 def _int_from_hash(h: bytes) -> int:

@@ -219,10 +219,13 @@ def verify_signature_sets_device_full(sets, rng=None) -> bool:
     import secrets as _secrets
 
     from ..crypto import bls
+    from ..metrics import inc_counter
 
     sets = list(sets)
     if not sets:
         return False
+    inc_counter("bls_device_batches_total")
+    inc_counter("bls_device_sets_total", len(sets))
     rand = rng if rng is not None else _secrets.SystemRandom()
 
     sig_affs = []

@@ -244,9 +244,9 @@ class BeaconStateHashCache:
             from .merkle import mix_in_length
 
             value = getattr(state, fname)
-            from .persistent import PersistentList
+            from .persistent import PersistentContainerList, PersistentList
 
-            if isinstance(value, PersistentList):
+            if isinstance(value, (PersistentList, PersistentContainerList)):
                 # the list carries its own block-memoized cache (shared
                 # across state copies) — strictly better than re-packing
                 return mix_in_length(

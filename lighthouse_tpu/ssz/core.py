@@ -26,7 +26,7 @@ from .merkle import (
     mix_in_selector,
     pack_bytes,
 )
-from .persistent import PersistentList
+from .persistent import PersistentContainerList, PersistentList
 
 BYTES_PER_LENGTH_OFFSET = 4
 
@@ -496,7 +496,7 @@ class List(SSZType):
 
     @classmethod
     def hash_tree_root_of(cls, value) -> bytes:
-        if isinstance(value, PersistentList):
+        if isinstance(value, (PersistentList, PersistentContainerList)):
             # structural-sharing fast path: block-memoized subtree roots
             root = value.hash_tree_root(cls.chunk_count())
         else:
@@ -515,6 +515,17 @@ class List(SSZType):
             # — without copy() there is no CoW barrier between the two)
             if cls.ELEM is not uint64:
                 raise ValueError("PersistentList fields must be uint64 lists")
+            if len(value) > cls.LIMIT:
+                raise ValueError(
+                    f"List limit {cls.LIMIT} exceeded: {len(value)}"
+                )
+            return value.copy()
+        if isinstance(value, PersistentContainerList):
+            if value.elem_t is not None and value.elem_t is not cls.ELEM:
+                raise ValueError(
+                    f"PersistentContainerList of {value.elem_t.__name__} "
+                    f"assigned to List[{cls.ELEM.__name__}]"
+                )
             if len(value) > cls.LIMIT:
                 raise ValueError(
                     f"List limit {cls.LIMIT} exceeded: {len(value)}"
@@ -896,7 +907,7 @@ class Container(SSZType, metaclass=_ContainerMeta):
 def _deep_copy(ftype, value):
     if isinstance(value, Container):
         return value.copy()
-    if isinstance(value, PersistentList):
+    if isinstance(value, (PersistentList, PersistentContainerList)):
         return value.copy()  # O(#blocks) structural share
     if isinstance(value, bytearray):
         return bytearray(value)

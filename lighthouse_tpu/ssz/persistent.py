@@ -1,4 +1,4 @@
-"""Persistent (structurally-shared) uint64 list with internal hash caching.
+"""Persistent (structurally-shared) lists with internal hash caching.
 
 The milhouse analog (the "tree-states" backbone: reference
 consensus/types/src/beacon_state.rs:34,371 stores `validators`/`balances`
@@ -209,15 +209,330 @@ class PersistentList:
             # root)
             return _fold_values(list(self), total_depth)
         roots = [blk.subtree_root() for blk in self._blocks]
-        if not roots:
-            roots = [ZERO_HASHES[_BLOCK_DEPTH]]
-        level = _BLOCK_DEPTH
-        while level < total_depth:
-            if len(roots) % 2:
-                roots.append(ZERO_HASHES[level])
-            roots = [
-                hash32_concat(roots[i], roots[i + 1])
-                for i in range(0, len(roots), 2)
-            ]
-            level += 1
-        return roots[0]
+        return _fold_roots(roots, _BLOCK_DEPTH, total_depth)
+
+
+def _fold_roots(roots: list[bytes], level: int, total_depth: int) -> bytes:
+    """Fold subtree roots (each at `level`) up to `total_depth`."""
+    if not roots:
+        roots = [ZERO_HASHES[level]]
+    while level < total_depth:
+        if len(roots) % 2:
+            roots.append(ZERO_HASHES[level])
+        roots = [
+            hash32_concat(roots[i], roots[i + 1])
+            for i in range(0, len(roots), 2)
+        ]
+        level += 1
+    return roots[0]
+
+
+# ---------------------------------------------------------------------------
+# Persistent container list (the milhouse `List<Validator>` analog)
+# ---------------------------------------------------------------------------
+
+CONTAINER_BLOCK = 256  # elements per block = a depth-8 subtree of roots
+_CONTAINER_DEPTH = (CONTAINER_BLOCK - 1).bit_length()  # 8
+
+
+def _elem_root(v) -> bytes:
+    """Element container root, memoized on the object (`_thc_root`;
+    Container.__setattr__ clears it — cached_tree_hash.rs's per-leaf memo)."""
+    root = v.__dict__.get("_thc_root")
+    if root is None:
+        root = type(v).hash_tree_root_of(v)
+        v.__dict__["_thc_root"] = root
+    return root
+
+
+class _CBlock:
+    __slots__ = ("items", "root")
+
+    def __init__(self, items: list):
+        self.items = items
+        self.root: bytes | None = None
+
+    def subtree_root(self) -> bytes:
+        if self.root is None:
+            self.root = _fold_root_chunks(
+                [_elem_root(v) for v in self.items]
+            )
+        return self.root
+
+
+def _fold_root_chunks(roots: list[bytes]) -> bytes:
+    import hashlib as _h
+
+    nodes = roots or [ZERO_HASHES[0]]
+    for level in range(_CONTAINER_DEPTH):
+        if len(nodes) % 2:
+            nodes.append(ZERO_HASHES[level])
+        nodes = [
+            _h.sha256(nodes[i] + nodes[i + 1]).digest()
+            for i in range(0, len(nodes), 2)
+        ]
+    return nodes[0]
+
+
+class PersistentContainerList:
+    """Structurally-shared list of SSZ Container elements — the milhouse
+    `List<Validator>` backbone (consensus/types/src/beacon_state.rs:34,371):
+    `copy()` is O(#blocks); per-element root memos + per-block subtree
+    memos make re-roots O(dirty); bulk (cold) builds vectorize element
+    roots columnar instead of one Python `hash_tree_root_of` per element.
+
+    MUTATION CONTRACT: elements inside the list are logically frozen.
+    Replace via `lst[i] = v`, or get a write-safe clone with
+    `lst.mutate(i)` (installs the clone, busts the memos, returns it for
+    in-place field writes). Mutating an element obtained from plain
+    indexing corrupts every copy that shares its block — the same rule
+    milhouse enforces with `&mut` access, checked here by the
+    cross-copy isolation tests."""
+
+    __slots__ = ("_blocks", "_owned", "elem_t")
+
+    def __init__(self, values=(), elem_t=None):
+        vals = list(values)
+        if elem_t is None and vals:
+            elem_t = type(vals[0])
+        self.elem_t = elem_t
+        self._blocks = [
+            _CBlock(vals[i : i + CONTAINER_BLOCK])
+            for i in range(0, len(vals), CONTAINER_BLOCK)
+        ]
+        self._owned = [True] * len(self._blocks)
+
+    # -- structural sharing ---------------------------------------------
+
+    def copy(self) -> "PersistentContainerList":
+        out = PersistentContainerList.__new__(PersistentContainerList)
+        out.elem_t = self.elem_t
+        out._blocks = list(self._blocks)
+        out._owned = [False] * len(self._blocks)
+        self._owned = [False] * len(self._blocks)
+        return out
+
+    def _own(self, bi: int) -> _CBlock:
+        blk = self._blocks[bi]
+        if not self._owned[bi]:
+            blk = _CBlock(list(blk.items))
+            self._blocks[bi] = blk
+            self._owned[bi] = True
+        blk.root = None
+        return blk
+
+    def shared_block_count(self, other: "PersistentContainerList") -> int:
+        mine = {id(b) for b in self._blocks}
+        return sum(1 for b in other._blocks if id(b) in mine)
+
+    # -- list surface ----------------------------------------------------
+
+    def __len__(self) -> int:
+        if not self._blocks:
+            return 0
+        return (len(self._blocks) - 1) * CONTAINER_BLOCK + len(
+            self._blocks[-1].items
+        )
+
+    def __iter__(self):
+        for blk in self._blocks:
+            yield from blk.items
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self)[idx]
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError(idx)
+        return self._blocks[idx // CONTAINER_BLOCK].items[idx % CONTAINER_BLOCK]
+
+    def __setitem__(self, idx, value):
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError(idx)
+        bi, off = divmod(idx, CONTAINER_BLOCK)
+        self._own(bi).items[off] = value
+
+    def mutate(self, idx):
+        """Write-safe element access: installs a clone of element `idx`
+        (busting the root memos) and returns it for field mutation."""
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError(idx)
+        bi, off = divmod(idx, CONTAINER_BLOCK)
+        blk = self._own(bi)
+        v = blk.items[off].copy()
+        v.__dict__.pop("_thc_root", None)
+        blk.items[off] = v
+        return v
+
+    def append(self, value):
+        if self._blocks and len(self._blocks[-1].items) < CONTAINER_BLOCK:
+            self._own(len(self._blocks) - 1).items.append(value)
+        else:
+            self._blocks.append(_CBlock([value]))
+            self._owned.append(True)
+
+    def __eq__(self, other):
+        if isinstance(other, (PersistentContainerList, list, tuple)):
+            return len(self) == len(other) and all(
+                a == b for a, b in zip(self, other)
+            )
+        return NotImplemented
+
+    def __repr__(self):
+        return (
+            f"PersistentContainerList(len={len(self)}, "
+            f"elem={getattr(self.elem_t, '__name__', None)})"
+        )
+
+    # -- hashing ----------------------------------------------------------
+
+    def hash_tree_root(self, limit_chunks: int) -> bytes:
+        total_depth = (limit_chunks - 1).bit_length() if limit_chunks > 1 else 0
+        if total_depth < _CONTAINER_DEPTH:
+            import hashlib as _h
+
+            nodes = [_elem_root(v) for v in self] or [ZERO_HASHES[0]]
+            for level in range(total_depth):
+                if len(nodes) % 2:
+                    nodes.append(ZERO_HASHES[level])
+                nodes = [
+                    _h.sha256(nodes[i] + nodes[i + 1]).digest()
+                    for i in range(0, len(nodes), 2)
+                ]
+            return nodes[0]
+        self._bulk_build_missing()
+        roots = [blk.subtree_root() for blk in self._blocks]
+        return _fold_roots(roots, _CONTAINER_DEPTH, total_depth)
+
+    def _bulk_build_missing(self):
+        """Vectorized cold path: compute memo-less element roots columnar
+        (one numpy pass per field + batched SHA-256) instead of per-element
+        Python Merkleization. Kicks in for big rebuilds only."""
+        pending = [
+            v
+            for blk in self._blocks
+            if blk.root is None
+            for v in blk.items
+            if "_thc_root" not in v.__dict__
+        ]
+        if len(pending) < 2 * CONTAINER_BLOCK:
+            return  # per-element path is fine at this size
+        bulk_container_roots(pending)
+
+
+def bulk_container_roots(elems: list) -> None:
+    """Compute `_thc_root` for every element in one columnar pass.
+
+    Requires a fixed-size container whose fields are basic uints, boolean,
+    or ByteVector — the Validator shape. Falls back silently (memos left
+    unset) for other shapes; callers then pay the per-element path."""
+    import hashlib as _h
+
+    import numpy as np
+
+    from .core import ByteVector, boolean, uint8, uint16, uint32, uint64
+
+    if not elems:
+        return
+    cls = type(elems[0])
+    fields = cls._fields
+    n = len(elems)
+    nf = len(fields)
+    pad_f = 1
+    while pad_f < nf:
+        pad_f *= 2
+    chunks = np.zeros((n, pad_f, 32), dtype=np.uint8)
+    for fi, (fname, ftype) in enumerate(fields.items()):
+        col = [v.__dict__[fname] for v in elems]
+        if isinstance(ftype, type) and issubclass(ftype, ByteVector):
+            size = ftype.fixed_size()
+            buf = np.frombuffer(b"".join(col), dtype=np.uint8).reshape(n, size)
+            if size <= 32:
+                chunks[:, fi, :size] = buf
+            else:
+                # multi-chunk bytes field (pubkey: 48B → 2 chunks → 1 hash)
+                nch = (size + 31) // 32
+                pad_c = 1
+                while pad_c < nch:
+                    pad_c *= 2
+                sub = np.zeros((n, pad_c * 32), dtype=np.uint8)
+                sub[:, :size] = buf
+                while pad_c > 1:
+                    sub = _np_hash_pairs(sub.reshape(n * pad_c // 2, 64)).reshape(
+                        n, -1
+                    )
+                    pad_c //= 2
+                chunks[:, fi, :] = sub.reshape(n, 32)
+        elif isinstance(ftype, type) and issubclass(
+            ftype, (boolean, uint8, uint16, uint32, uint64)
+        ):
+            size = ftype.fixed_size()
+            arr = np.fromiter(col, dtype=np.uint64, count=n)
+            raw = arr.astype("<u8").view(np.uint8).reshape(n, 8)
+            chunks[:, fi, :size] = raw[:, :size]
+        else:
+            return  # unsupported shape: leave memos unset
+    # fold the field axis: pad_f chunks → 1 root per element
+    cur = chunks.reshape(n * pad_f // 2, 64)
+    width = pad_f
+    while width > 1:
+        cur = _np_hash_pairs(cur)
+        width //= 2
+        if width > 1:
+            cur = cur.reshape(n * width // 2, 64)
+    roots = cur.reshape(n, 32)
+    for i, v in enumerate(elems):
+        v.__dict__["_thc_root"] = roots[i].tobytes()
+
+
+_DEVICE_HASH_THRESHOLD = 1 << 17  # rows; below this, hashlib wins
+
+
+def _np_hash_pairs(pairs):
+    """[m, 64] uint8 → [m, 32] uint8 SHA-256 rows. Big batches ride the
+    device kernel (ops/sha256, one call); the rest use one C-speed
+    hashlib pass over a contiguous buffer (no per-row numpy objects)."""
+    import hashlib as _h
+
+    import numpy as np
+
+    m = pairs.shape[0]
+    if m >= _DEVICE_HASH_THRESHOLD:
+        try:
+            import jax
+
+            if jax.default_backend() == "cpu":
+                # the XLA-CPU kernel is ~20× slower than hashlib here;
+                # the device path is for real accelerators only
+                raise RuntimeError("cpu backend")
+            from ..ops.sha256 import sha256_pairs
+
+            # pad the row count to a power of two: one compiled shape per
+            # size class instead of one per call site
+            mp = 1 << (m - 1).bit_length()
+            words = np.zeros((mp, 16), dtype=np.uint32)
+            words[:m] = (
+                np.ascontiguousarray(pairs)
+                .view(">u4")
+                .astype(np.uint32)
+                .reshape(m, 16)
+            )
+            dig = np.asarray(sha256_pairs(words))[:m]
+            return dig.astype(">u4").view(np.uint8).reshape(m, 32)
+        except Exception:  # noqa: BLE001 — no device: fall through
+            pass
+    data = pairs.tobytes()
+    out = bytearray(m * 32)
+    mv = memoryview(data)
+    sha = _h.sha256
+    for i in range(m):
+        out[i * 32 : (i + 1) * 32] = sha(mv[i * 64 : (i + 1) * 64]).digest()
+    return np.frombuffer(bytes(out), dtype=np.uint8).reshape(m, 32)

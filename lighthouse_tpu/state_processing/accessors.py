@@ -328,6 +328,17 @@ def get_validator_churn_limit(state, spec: ChainSpec, E) -> int:
     return spec.churn_limit(active)
 
 
+def mutable_validator(state, index: int):
+    """Write-safe validator access. A PersistentContainerList registry
+    shares element objects across state copies, so field mutation must go
+    through its copy-on-write `mutate()`; plain-list registries own their
+    elements and return them directly. EVERY validator field write in the
+    state transition uses this helper (the milhouse `&mut` discipline)."""
+    vs = state.validators
+    m = getattr(vs, "mutate", None)
+    return m(index) if m is not None else vs[index]
+
+
 def initiate_validator_exit(state, index: int, spec: ChainSpec, E):
     if hasattr(state, "earliest_exit_epoch"):
         # Electra: weight-denominated exit churn (EIP-7251)
@@ -335,9 +346,9 @@ def initiate_validator_exit(state, index: int, spec: ChainSpec, E):
 
         initiate_validator_exit_electra(state, index, spec, E)
         return
-    v = state.validators[index]
-    if v.exit_epoch != FAR_FUTURE_EPOCH:
+    if state.validators[index].exit_epoch != FAR_FUTURE_EPOCH:
         return
+    v = mutable_validator(state, index)
     exit_epochs = [
         w.exit_epoch for w in state.validators if w.exit_epoch != FAR_FUTURE_EPOCH
     ]
@@ -365,7 +376,7 @@ def slash_validator(
     fork = build_types(E).fork_of_state(state)
     epoch = get_current_epoch(state, E)
     initiate_validator_exit(state, slashed_index, spec, E)
-    v = state.validators[slashed_index]
+    v = mutable_validator(state, slashed_index)
     v.slashed = True
     v.withdrawable_epoch = max(
         v.withdrawable_epoch, epoch + E.EPOCHS_PER_SLASHINGS_VECTOR

@@ -11,6 +11,7 @@ from ..types.chain_spec import ChainSpec, Domain, compute_signing_root
 from .accessors import (
     decrease_balance,
     get_current_epoch,
+    mutable_validator,
 )
 
 BLS_WITHDRAWAL_PREFIX = b"\x00"
@@ -162,7 +163,7 @@ def process_bls_to_execution_change(
         state, signed_change, spec, E
     ).verify():
         raise BlockProcessingError("bls change: bad signature")
-    validator.withdrawal_credentials = (
+    mutable_validator(state, change.validator_index).withdrawal_credentials = (
         ETH1_ADDRESS_WITHDRAWAL_PREFIX
         + b"\x00" * 11
         + bytes(change.to_execution_address)

@@ -19,6 +19,7 @@ from ..types.chain_spec import FAR_FUTURE_EPOCH, ChainSpec
 from .accessors import (
     compute_activation_exit_epoch,
     decrease_balance,
+    mutable_validator,
     get_current_epoch,
     get_total_active_balance,
     increase_balance,
@@ -144,12 +145,12 @@ def compute_consolidation_epoch_and_update_churn(
 
 
 def initiate_validator_exit_electra(state, index: int, spec: ChainSpec, E):
-    v = state.validators[index]
-    if v.exit_epoch != FAR_FUTURE_EPOCH:
+    if state.validators[index].exit_epoch != FAR_FUTURE_EPOCH:
         return
     exit_queue_epoch = compute_exit_epoch_and_update_churn(
-        state, v.effective_balance, spec, E
+        state, state.validators[index].effective_balance, spec, E
     )
+    v = mutable_validator(state, index)
     v.exit_epoch = exit_queue_epoch
     v.withdrawable_epoch = (
         exit_queue_epoch + spec.min_validator_withdrawability_delay
@@ -178,7 +179,7 @@ def queue_entire_balance_and_reset_validator(state, index: int, spec: ChainSpec,
 
     balance = state.balances[index]
     state.balances[index] = 0
-    v = state.validators[index]
+    v = mutable_validator(state, index)
     v.effective_balance = 0
     v.activation_eligibility_epoch = FAR_FUTURE_EPOCH
     if balance > 0:
@@ -188,8 +189,8 @@ def queue_entire_balance_and_reset_validator(state, index: int, spec: ChainSpec,
 
 
 def switch_to_compounding_validator(state, index: int, spec: ChainSpec, E):
-    v = state.validators[index]
-    if has_execution_withdrawal_credential(v, spec):
+    if has_execution_withdrawal_credential(state.validators[index], spec):
+        v = mutable_validator(state, index)
         v.withdrawal_credentials = (
             bytes([spec.compounding_withdrawal_prefix_byte])
             + v.withdrawal_credentials[1:]
@@ -456,7 +457,7 @@ def process_effective_balance_updates_electra(state, spec: ChainSpec, E):
         balance = state.balances[index]
         max_eb = get_validator_max_effective_balance(v, spec)
         if balance + down < v.effective_balance or v.effective_balance + up < balance:
-            v.effective_balance = min(
+            mutable_validator(state, index).effective_balance = min(
                 balance - balance % E.EFFECTIVE_BALANCE_INCREMENT, max_eb
             )
 

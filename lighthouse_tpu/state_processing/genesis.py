@@ -136,8 +136,11 @@ def initialize_beacon_state_from_eth1(
         process_deposit(state, deposit, spec, E, signature_verified=all_sigs_ok)
 
     # Process activations
-    for index, validator in enumerate(state.validators):
+    from .accessors import mutable_validator
+
+    for index in range(len(state.validators)):
         balance = state.balances[index]
+        validator = mutable_validator(state, index)
         validator.effective_balance = min(
             balance - balance % E.EFFECTIVE_BALANCE_INCREMENT,
             E.MAX_EFFECTIVE_BALANCE,

@@ -11,6 +11,7 @@ from __future__ import annotations
 from ..types.chain_spec import FAR_FUTURE_EPOCH, GENESIS_EPOCH, ChainSpec
 from .accessors import (
     compute_activation_exit_epoch,
+    mutable_validator,
     decrease_balance,
     get_active_validator_indices,
     get_attesting_indices,
@@ -378,7 +379,9 @@ def process_registry_updates(state, spec: ChainSpec, E, arrays=None):
             effective == np.uint64(E.MAX_EFFECTIVE_BALANCE)
         )
     for i in np.nonzero(new_eligible)[0]:
-        vs[int(i)].activation_eligibility_epoch = current + 1
+        mutable_validator(state, int(i)).activation_eligibility_epoch = (
+            current + 1
+        )
         eligibility[i] = current + 1
         changed.add(int(i))
 
@@ -405,7 +408,7 @@ def process_registry_updates(state, spec: ChainSpec, E, arrays=None):
         limit = spec.activation_churn_limit(active_count, fork)
     target = compute_activation_exit_epoch(current, E)
     for i in activation_queue[:limit]:
-        vs[int(i)].activation_epoch = target
+        mutable_validator(state, int(i)).activation_epoch = target
         changed.add(int(i))
     return sorted(changed)
 
@@ -457,7 +460,7 @@ def process_effective_balance_updates(state, E, arrays=None):
         balances - balances % increment, np.uint64(E.MAX_EFFECTIVE_BALANCE)
     )
     for i in np.nonzero(stale)[0]:
-        state.validators[int(i)].effective_balance = int(new_eff[i])
+        mutable_validator(state, int(i)).effective_balance = int(new_eff[i])
         if arrays is not None:
             arrays.effective_balance[i] = new_eff[i]
 

@@ -436,7 +436,14 @@ def build_types(E: type) -> SimpleNamespace:
 
     # -- Electra (EIP-7251 maxeb / EIP-7002 EL withdrawals / EIP-6110
     #    deposit receipts; reference consensus/types/src/{deposit_receipt,
-    #    execution_layer_withdrawal_request,pending_*}.rs) ------------------
+    #    execution_layer_withdrawal_request,pending_*}.rs)
+    #
+    #    NOTE: these Electra shapes follow the ~2024-10 devnet spec the
+    #    reference snapshot tracks (e.g. `DepositReceipt`, per-payload
+    #    `withdrawal_requests`), NOT the finalized mainnet Electra layout
+    #    (which moved EL requests out of the payload into
+    #    `ExecutionRequests`). Callers building against mainnet Electra
+    #    must update these containers first. --------------------------------
 
     class DepositReceipt(Container):
         pubkey: BLSPubkey
