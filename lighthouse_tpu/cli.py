@@ -89,6 +89,7 @@ def cmd_beacon_node(args):
         genesis_state=checkpoint_state,
         slasher=args.slasher,
         bls_backend=backend,
+        kzg=args.kzg,
     )
     client = ClientBuilder(cfg).build().start()
     log.info(
@@ -313,9 +314,37 @@ def cmd_am(args):
     from .crypto.wallet import Wallet
 
     if args.am_cmd == "wallet-create":
-        seed = bytes.fromhex(args.seed) if args.seed else None
-        w = Wallet.create(
-            args.name, args.password, seed=seed, _fast_kdf=args.fast_kdf
+        mnemonic = None
+        if args.seed:
+            w = Wallet.create(
+                args.name,
+                args.password,
+                seed=bytes.fromhex(args.seed),
+                _fast_kdf=args.fast_kdf,
+            )
+        else:
+            # account_manager wallet create: fresh BIP-39 mnemonic, shown
+            # exactly once (create.rs)
+            w, mnemonic = Wallet.create_with_mnemonic(
+                args.name, args.password, _fast_kdf=args.fast_kdf
+            )
+        out = pathlib.Path(args.dir)
+        out.mkdir(parents=True, exist_ok=True)
+        (out / f"{w.doc['uuid']}.json").write_text(w.to_json())
+        print(json.dumps({"uuid": w.doc["uuid"], "name": w.name}))
+        if mnemonic is not None:
+            print(
+                "RECOVERY MNEMONIC (shown once, store it safely):\n"
+                f"{mnemonic}",
+                file=sys.stderr,
+            )
+        return 0
+    if args.am_cmd == "wallet-recover":
+        w = Wallet.recover(
+            args.name,
+            args.password,
+            args.mnemonic or input("mnemonic: "),
+            _fast_kdf=args.fast_kdf,
         )
         out = pathlib.Path(args.dir)
         out.mkdir(parents=True, exist_ok=True)
@@ -476,15 +505,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="SSZ BeaconState file to boot from (checkpoint sync)",
     )
     bn.add_argument("--slasher", action="store_true")
+    bn.add_argument(
+        "--kzg",
+        choices=["none", "default", "dev"],
+        default="default",
+        help="blob DA engine: default = packaged mainnet ceremony setup; "
+        "device kernels when --bls-backend tpu (crypto/kzg/src/lib.rs:35)",
+    )
     bn.add_argument("--run-for", type=float, default=None, help="seconds then exit")
     bn.set_defaults(fn=cmd_beacon_node)
 
     am = sub.add_parser("am", help="account manager (wallets, exits)")
     am.add_argument(
-        "am_cmd", choices=["wallet-create", "wallet-list", "exit"]
+        "am_cmd", choices=["wallet-create", "wallet-recover", "wallet-list", "exit"]
     )
     am.add_argument("--dir", default=".")
     am.add_argument("--name", default="wallet")
+    am.add_argument(
+        "--mnemonic", default=None, help="BIP-39 phrase for wallet-recover"
+    )
     am.add_argument("--password", default="")
     am.add_argument("--seed", default=None, help="hex seed (random if unset)")
     am.add_argument("--fast-kdf", action="store_true")

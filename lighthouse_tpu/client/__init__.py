@@ -47,6 +47,11 @@ class ClientConfig:
     # device epoch sweep on by default (LIGHTHOUSE_TPU_DEVICE_EPOCH_SWEEP
     # still overrides either way).
     bls_backend: str = "host"
+    # KZG engine for blob availability (crypto/kzg/src/lib.rs:35):
+    # "none" = no blobs accepted, "default" = packaged mainnet ceremony,
+    # "dev" = insecure dev setup (tests/devnets). With bls_backend="tpu"
+    # the engine runs its MSM/pairing/Fr kernels on device.
+    kzg: str = "none"
 
 
 class Client:
@@ -163,6 +168,17 @@ class ClientBuilder:
             from ..types.containers import build_types
 
             execution_layer = MockExecutionLayer(build_types(cfg.E), cfg.E)
+        # kzg engine (blob DA); device kernels ride the tpu backend
+        kzg = None
+        if cfg.kzg != "none":
+            from ..crypto.kzg import Kzg, TrustedSetup
+
+            setup = (
+                TrustedSetup.insecure_dev()
+                if cfg.kzg == "dev"
+                else TrustedSetup.default()
+            )
+            kzg = Kzg(setup, device=(cfg.bls_backend == "tpu") or None)
         # chain
         c.chain = BeaconChain(
             store=store,
@@ -171,6 +187,7 @@ class ClientBuilder:
             E=cfg.E,
             slot_clock=c.slot_clock,
             execution_layer=execution_layer,
+            kzg=kzg,
         )
         # network
         if cfg.network_port is not None:

@@ -90,3 +90,46 @@ class Wallet:
     @classmethod
     def from_json(cls, data: str | bytes) -> "Wallet":
         return cls(json.loads(data))
+
+    # -- BIP-39 flows (account_manager/src/wallet/{create,recover}.rs) ------
+
+    @classmethod
+    def create_with_mnemonic(
+        cls,
+        name: str,
+        password: str,
+        mnemonic: str | None = None,
+        mnemonic_passphrase: str = "",
+        _fast_kdf: bool = False,
+    ) -> tuple["Wallet", str]:
+        """New wallet from a (possibly fresh) BIP-39 mnemonic. Returns
+        (wallet, mnemonic) — the caller shows the phrase exactly once."""
+        from .bip39 import generate_mnemonic, mnemonic_to_seed
+
+        if mnemonic is None:
+            mnemonic = generate_mnemonic(256)
+        seed = mnemonic_to_seed(mnemonic, mnemonic_passphrase)
+        return (
+            cls.create(name, password, seed=seed, _fast_kdf=_fast_kdf),
+            mnemonic,
+        )
+
+    @classmethod
+    def recover(
+        cls,
+        name: str,
+        password: str,
+        mnemonic: str,
+        mnemonic_passphrase: str = "",
+        _fast_kdf: bool = False,
+    ) -> "Wallet":
+        """Rebuild a wallet from its mnemonic — same seed, so the same
+        EIP-2334 account derivations come back out."""
+        w, _ = cls.create_with_mnemonic(
+            name,
+            password,
+            mnemonic=mnemonic,
+            mnemonic_passphrase=mnemonic_passphrase,
+            _fast_kdf=_fast_kdf,
+        )
+        return w

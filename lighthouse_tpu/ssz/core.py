@@ -757,6 +757,14 @@ class _ContainerMeta(type):
         return cls
 
 
+class FrozenElementError(AttributeError):
+    """Raised on direct field writes to a container element that is
+    structurally shared inside a PersistentContainerList — the milhouse
+    `&mut`-discipline analog (consensus/types/src/beacon_state.rs:34):
+    a missed copy-on-write would silently corrupt every state copy
+    sharing the element's block, so the write raises instead."""
+
+
 class Container(SSZType, metaclass=_ContainerMeta):
     _fields: dict[str, type] = {}
 
@@ -772,6 +780,13 @@ class Container(SSZType, metaclass=_ContainerMeta):
     def __setattr__(self, name, value):
         ftype = self._fields.get(name)
         if ftype is not None:
+            if "_frozen" in self.__dict__:
+                raise FrozenElementError(
+                    f"{type(self).__name__}.{name}: this element is shared "
+                    f"inside a PersistentContainerList (structural sharing "
+                    f"across state copies); use lst.mutate(i) to get a "
+                    f"write-safe clone"
+                )
             value = ftype.coerce(value)
             # field mutation invalidates this container's memoized root
             # (cached_tree_hash: the per-validator root memo)

@@ -281,15 +281,16 @@ class PersistentContainerList:
     memos make re-roots O(dirty); bulk (cold) builds vectorize element
     roots columnar instead of one Python `hash_tree_root_of` per element.
 
-    MUTATION CONTRACT: elements inside the list are logically frozen.
-    Replace via `lst[i] = v`, or get a write-safe clone with
-    `lst.mutate(i)` (installs the clone, busts the memos, returns it for
-    in-place field writes). Mutating an element obtained from plain
-    indexing corrupts every copy that shares its block — the same rule
-    milhouse enforces with `&mut` access, checked here by the
-    cross-copy isolation tests."""
+    MUTATION CONTRACT (enforced): elements inside the list are frozen —
+    direct field writes raise `FrozenElementError` (the milhouse `&mut`
+    discipline, checked at write time instead of by convention). Replace
+    via `lst[i] = v`, or get a write-safe clone with `lst.mutate(i)`
+    (installs the clone, busts the memos, returns it for in-place field
+    writes). Clones handed out by `mutate()` stay writable until the
+    list is next copied, at which point they are re-frozen (the block
+    becomes shared again)."""
 
-    __slots__ = ("_blocks", "_owned", "elem_t")
+    __slots__ = ("_blocks", "_owned", "elem_t", "_thawed")
 
     def __init__(self, values=(), elem_t=None):
         vals = list(values)
@@ -301,14 +302,23 @@ class PersistentContainerList:
             for i in range(0, len(vals), CONTAINER_BLOCK)
         ]
         self._owned = [True] * len(self._blocks)
+        self._thawed = []
+        for v in vals:
+            v.__dict__["_frozen"] = True
 
     # -- structural sharing ---------------------------------------------
 
     def copy(self) -> "PersistentContainerList":
+        # re-freeze the clones mutate() handed out: their blocks are about
+        # to be shared, so further direct writes would corrupt both sides
+        for v in self._thawed:
+            v.__dict__["_frozen"] = True
+        self._thawed = []
         out = PersistentContainerList.__new__(PersistentContainerList)
         out.elem_t = self.elem_t
         out._blocks = list(self._blocks)
         out._owned = [False] * len(self._blocks)
+        out._thawed = []
         self._owned = [False] * len(self._blocks)
         return out
 
@@ -355,11 +365,13 @@ class PersistentContainerList:
         if not 0 <= idx < n:
             raise IndexError(idx)
         bi, off = divmod(idx, CONTAINER_BLOCK)
+        value.__dict__["_frozen"] = True
         self._own(bi).items[off] = value
 
     def mutate(self, idx):
         """Write-safe element access: installs a clone of element `idx`
-        (busting the root memos) and returns it for field mutation."""
+        (busting the root memos) and returns it for field mutation.
+        The clone is writable until this list is next copied."""
         n = len(self)
         if idx < 0:
             idx += n
@@ -367,12 +379,14 @@ class PersistentContainerList:
             raise IndexError(idx)
         bi, off = divmod(idx, CONTAINER_BLOCK)
         blk = self._own(bi)
-        v = blk.items[off].copy()
+        v = blk.items[off].copy()  # Container.copy() drops _frozen
         v.__dict__.pop("_thc_root", None)
         blk.items[off] = v
+        self._thawed.append(v)
         return v
 
     def append(self, value):
+        value.__dict__["_frozen"] = True
         if self._blocks and len(self._blocks[-1].items) < CONTAINER_BLOCK:
             self._own(len(self._blocks) - 1).items.append(value)
         else:

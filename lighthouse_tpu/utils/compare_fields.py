@@ -50,8 +50,9 @@ def _walk(a, b, path, diffs, max_diffs):
                 max_diffs,
             )
         return
-    a_listy = isinstance(a, (list, tuple)) or type(a).__name__ == "PersistentList"
-    b_listy = isinstance(b, (list, tuple)) or type(b).__name__ == "PersistentList"
+    _plist_names = ("PersistentList", "PersistentContainerList")
+    a_listy = isinstance(a, (list, tuple)) or type(a).__name__ in _plist_names
+    b_listy = isinstance(b, (list, tuple)) or type(b).__name__ in _plist_names
     if a_listy and b_listy:
         if len(a) != len(b):
             diffs.append(FieldDiff(f"{path}.len", len(a), len(b)))

@@ -1,0 +1,97 @@
+"""BIP-39 mnemonic encode/decode/seed vs the spec's published vectors.
+
+Vectors are from the BIP-39 reference test set (trezor/python-mnemonic
+vectors.json — passphrase "TREZOR"), the same set the reference's bip39
+crate pins (account_manager/src/wallet/create.rs consumer)."""
+
+import pytest
+
+from lighthouse_tpu.crypto.bip39 import (
+    Bip39Error,
+    entropy_to_mnemonic,
+    generate_mnemonic,
+    mnemonic_to_entropy,
+    mnemonic_to_seed,
+    validate_mnemonic,
+)
+
+# (entropy_hex, mnemonic, seed_hex_with_TREZOR_passphrase)
+SPEC_VECTORS = [
+    (
+        "00000000000000000000000000000000",
+        "abandon abandon abandon abandon abandon abandon abandon abandon "
+        "abandon abandon abandon about",
+        "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e5349553"
+        "1f09a6987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04",
+    ),
+    (
+        "80808080808080808080808080808080",
+        "letter advice cage absurd amount doctor acoustic avoid letter "
+        "advice cage above",
+        "d71de856f81a8acc65e6fc851a38d4d7ec216fd0796d0a6827a3ad6ed5511a30"
+        "fa280f12eb2e47ed2ac03b5c462a0358d18d69fe4f985ec81778c1b370b652a8",
+    ),
+    (
+        "ffffffffffffffffffffffffffffffff",
+        "zoo zoo zoo zoo zoo zoo zoo zoo zoo zoo zoo wrong",
+        "ac27495480225222079d7be181583751e86f571027b0497b5b5d11218e0a8a13"
+        "332572917f0f8e5a589620c6f15b11c61dee327651a14c34e18231052e48c069",
+    ),
+]
+
+
+@pytest.mark.parametrize("ent_hex,mnemonic,seed_hex", SPEC_VECTORS)
+def test_spec_vectors(ent_hex, mnemonic, seed_hex):
+    entropy = bytes.fromhex(ent_hex)
+    assert entropy_to_mnemonic(entropy) == mnemonic
+    assert mnemonic_to_entropy(mnemonic) == entropy
+    assert mnemonic_to_seed(mnemonic, "TREZOR").hex() == seed_hex
+
+
+@pytest.mark.parametrize("strength", [128, 160, 192, 224, 256])
+def test_roundtrip_all_strengths(strength):
+    import hashlib
+
+    entropy = hashlib.sha256(f"e{strength}".encode()).digest()[: strength // 8]
+    m = entropy_to_mnemonic(entropy)
+    assert len(m.split()) == (strength + strength // 32) // 11
+    assert mnemonic_to_entropy(m) == entropy
+    assert validate_mnemonic(m)
+
+
+def test_generate_is_valid_and_random():
+    a = generate_mnemonic(256)
+    b = generate_mnemonic(256)
+    assert a != b
+    assert len(a.split()) == 24
+    assert validate_mnemonic(a)
+
+
+def test_rejections():
+    good = SPEC_VECTORS[0][1]
+    # swapped word order breaks the checksum
+    words = good.split()
+    words[0], words[-1] = words[-1], words[0]
+    assert not validate_mnemonic(" ".join(words))
+    with pytest.raises(Bip39Error, match="checksum"):
+        mnemonic_to_entropy(" ".join(words))
+    with pytest.raises(Bip39Error, match="unknown"):
+        mnemonic_to_entropy(good.replace("about", "zzzz"))
+    with pytest.raises(Bip39Error, match="words"):
+        mnemonic_to_entropy("abandon abandon")
+    with pytest.raises(Bip39Error):
+        entropy_to_mnemonic(b"\x00" * 13)
+
+
+def test_wallet_mnemonic_recovery_roundtrip():
+    """create_with_mnemonic → recover yields the same seed, hence the
+    same first validator keystore (create.rs/recover.rs behavior)."""
+    from lighthouse_tpu.crypto.wallet import Wallet
+
+    w, mnemonic = Wallet.create_with_mnemonic("w1", "pw", _fast_kdf=True)
+    assert validate_mnemonic(mnemonic)
+    w2 = Wallet.recover("w1-again", "pw2", mnemonic, _fast_kdf=True)
+    assert w.decrypt_seed("pw") == w2.decrypt_seed("pw2")
+    ks1 = w.next_validator("pw", "kpw", _fast_kdf=True)
+    ks2 = w2.next_validator("pw2", "kpw", _fast_kdf=True)
+    assert ks1.decrypt("kpw") == ks2.decrypt("kpw")

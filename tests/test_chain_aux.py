@@ -167,12 +167,38 @@ def test_compare_fields_pinpoints_divergence():
     assert compare_fields(a, b) == []
     b.slot = 99
     b.balances[3] = 123
-    b.validators[1].slashed = True
+    b.validators.mutate(1).slashed = True  # CoW: never b.validators[1].x =
     diffs = {d.path: d for d in compare_fields(a, b)}
     assert any(p.endswith(".slot") for p in diffs)
     assert any("balances[3]" in p for p in diffs)
     assert any("validators[1].slashed" in p for p in diffs)
     assert len(diffs) == 3
+
+
+def test_registry_elements_are_frozen_against_direct_mutation():
+    """milhouse &mut discipline (beacon_state.rs:34): a direct field write
+    on a registry element shared across state copies must raise, not
+    silently corrupt the sibling copy."""
+    import pytest
+
+    from lighthouse_tpu.ssz.core import FrozenElementError
+
+    h = _harness()
+    a = h.chain.head_state
+    b = a.copy()
+    with pytest.raises(FrozenElementError):
+        b.validators[1].slashed = True
+    # the original is untouched and the sanctioned path still works
+    assert a.validators[1].slashed is False
+    b.validators.mutate(1).slashed = True
+    assert b.validators[1].slashed is True
+    assert a.validators[1].slashed is False
+    # a clone handed out by mutate() is re-frozen once the list is copied
+    v = b.validators.mutate(2)
+    v.effective_balance = 7
+    c = b.copy()  # noqa: F841 — blocks now shared again
+    with pytest.raises(FrozenElementError):
+        v.effective_balance = 8
 
 
 def test_fork_revert_refuses_finalized():
