@@ -414,26 +414,48 @@ class Kzg:
         if device:
             try:
                 self._dev = _DeviceKzg(self.setup)
-            except Exception:
-                self._dev = None
+            except Exception as e:  # noqa: BLE001 — e.g. remote-compile failure
+                self._device_fallback("init", e)
+
+    @staticmethod
+    def _strict_device() -> bool:
+        return os.environ.get("LIGHTHOUSE_TPU_STRICT_DEVICE") == "1"
+
+    def _device_fallback(self, stage: str, e: Exception):
+        """Device path failed: count it (a fallback must never be
+        invisible — a shape regression on the chip would otherwise
+        silently turn TPU-native DA into host bigint math), log once, and
+        under LIGHTHOUSE_TPU_STRICT_DEVICE=1 refuse to fall back at all."""
+        from ...metrics import inc_counter
+
+        inc_counter("kzg_device_fallback_total", stage=stage)
+        if self._strict_device():
+            self._dev = None
+            raise KzgError(
+                f"device KZG failed at {stage} and "
+                f"LIGHTHOUSE_TPU_STRICT_DEVICE=1 forbids host fallback: {e}"
+            ) from e
+        if not self._dev_warned:
+            self._dev_warned = True
+            from ...utils.logging import get_logger
+
+            get_logger("lighthouse_tpu.kzg").warning(
+                "device KZG path failed; falling back to host",
+                stage=stage,
+                error=str(e)[:200],
+            )
+        self._dev = None
 
     def _device_call(self, fn, *args):
         """Run a device-path closure; on failure, disable the device path
-        (loudly, once) and return None so callers fall back to host."""
+        (observably — see _device_fallback) and return None so callers
+        fall back to host."""
         if self._dev is None:
             return None
         try:
             return fn(self._dev, *args)
         except Exception as e:  # noqa: BLE001 — e.g. remote-compile failure
-            if not self._dev_warned:
-                self._dev_warned = True
-                from ...utils.logging import get_logger
-
-                get_logger("lighthouse_tpu.kzg").warning(
-                    "device KZG path failed; falling back to host",
-                    error=str(e)[:200],
-                )
-            self._dev = None
+            self._device_fallback("call", e)
             return None
 
     # -- commitments ----------------------------------------------------------

@@ -193,5 +193,18 @@ def observe(name: str, value: float):
     REGISTRY.histogram(name).observe(value)
 
 
+def set_distribution(name: str, values, **labels):
+    """Expose a small population as min/p50/max gauges (one `q` label) —
+    for distributions whose membership churns (gossipsub peer scores),
+    where a histogram's cumulative buckets would never forget old peers."""
+    vs = sorted(values)
+    if not vs:
+        return
+    g = REGISTRY.gauge(name)
+    g.set(vs[0], q="min", **labels)
+    g.set(vs[len(vs) // 2], q="p50", **labels)
+    g.set(vs[-1], q="max", **labels)
+
+
 def start_timer(name: str) -> _Timer:
     return REGISTRY.histogram(name).start_timer()

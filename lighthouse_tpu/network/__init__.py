@@ -8,13 +8,17 @@ scoring/banning, range sync — on the host network (ICI/DCN carry only
 device collectives; p2p always stays on the host CPU). Transport security
 is the real libp2p Noise XX handshake (network/noise.py) when a
 NoiseTransport is supplied: streams are then encrypted and the peer's
-ed25519 identity is verified and used for identity-level bans. Remaining
-wire-compat gaps vs mainnet libp2p: multistream-select/yamux muxing and
-discv5 packet crypto (discovery here uses its own UDP record protocol).
+ed25519 identity is verified and used for identity-level bans. Gossip now
+runs a real gossipsub v1.1 control mesh (network/gossipsub/): per-topic
+D-regular meshes with heartbeat GRAFT/PRUNE maintenance, IHAVE/IWANT lazy
+gossip over an mcache, and peer scoring gating every mesh decision.
+Remaining wire-compat gaps vs mainnet libp2p: multistream-select/yamux
+muxing, protobuf gossipsub RPC (frames here are SSZ behind a tag byte),
+and discv5 packet crypto (discovery uses its own UDP record protocol).
 
 Components: `NetworkService` (service/mod.rs analog) owning the server +
-peer set, `GossipRouter` (vendored-gossipsub stand-in: flood publish with
-spec message-id dedup), `PeerManager` (scoring/banning,
+peer set, `GossipRouter` (socket/handler bridge around
+gossipsub.GossipsubBehaviour), `PeerManager` (scoring/banning,
 peer_manager/peerdb/score.rs), `SyncManager` (range sync,
 network/src/sync/manager.rs)."""
 
@@ -28,6 +32,13 @@ from dataclasses import dataclass, field
 from ..metrics import inc_counter, set_gauge
 from ..utils.logging import get_logger
 from . import messages as M
+from .gossipsub import (
+    FrameError,
+    GossipsubBehaviour,
+    beacon_score_params,
+    beacon_score_thresholds,
+    decode_frame,
+)
 from .rpc import (
     RpcClient,
     RpcError,
@@ -147,6 +158,12 @@ class PeerManager:
         with self._lock:
             return [p for p in self._peers.values() if not p.banned]
 
+    def get(self, peer_id: str) -> Peer | None:
+        """A connected, non-banned peer by id (None otherwise)."""
+        with self._lock:
+            p = self._peers.get(peer_id)
+            return None if p is None or p.banned else p
+
     def report(self, peer_id: str, delta: float) -> Peer | None:
         """Score adjustment; banning at threshold (score.rs behavior). A
         fresh ban also severs the live connection — the reference
@@ -179,71 +196,141 @@ class PeerManager:
 
 
 class GossipRouter:
-    """Flood-publish pub/sub with spec message-ids and seen-cache dedup —
-    the in-process stand-in for the vendored gossipsub (17k LoC in the
-    reference; mesh management/scoring collapse to flood + PeerManager
-    scores at this node count)."""
+    """Socket/handler bridge around a gossipsub v1.1 control mesh.
 
-    def __init__(self, service: "NetworkService"):
+    The flood-publish stand-in graduated (network/gossipsub/): the
+    behaviour owns mesh membership, the mcache, scoring and dedup; this
+    router supplies its transport (peer sockets via the PeerManager), its
+    validation (the per-topic chain handlers, whose accept/reject drives
+    BOTH gossipsub scoring and the PeerManager's ban scores), and its
+    peer-exchange records. Publish/subscribe signatures are unchanged —
+    the rest of the node doesn't know the fan-out got a mesh."""
+
+    def __init__(
+        self,
+        service: "NetworkService",
+        params=None,
+        thresholds=None,
+        config=None,
+    ):
         self.service = service
-        self._seen: dict[bytes, float] = {}
-        self._lock = threading.Lock()
         self._handlers: dict[str, object] = {}
+        domain = service.spec.message_domain_valid_snappy
+        self.behaviour = GossipsubBehaviour(
+            send=self._send_frame,
+            deliver=self._deliver,
+            mid_fn=lambda data: M.message_id(domain, data),
+            px_provider=self._px_records,
+            params=params,
+            thresholds=thresholds,
+            config=config,
+        )
 
     def subscribe(self, topic: str, handler):
         self._handlers[topic] = handler
+        self.behaviour.subscribe(topic)
 
-    _SEEN_CAP = 1 << 16
+    def publish(self, topic: str, data: bytes):
+        """Local publish: into the mcache and out via the mesh."""
+        self.behaviour.publish(topic, data)
 
-    def _first_sight(self, mid: bytes) -> bool:
-        with self._lock:
-            if mid in self._seen:
-                return False
-            self._seen[mid] = time.monotonic()
-            # dict preserves insertion order: evict oldest past the cap,
-            # O(evictions) not O(n), bounded regardless of message rate
-            while len(self._seen) > self._SEEN_CAP:
-                self._seen.pop(next(iter(self._seen)))
-            return True
+    def ensure_mesh(self, topic: str):
+        """Eagerly fill a topic's mesh (duty-subnet joins shouldn't wait
+        for the next heartbeat)."""
+        self.behaviour.graft_now(topic)
 
-    def publish(self, topic: str, data: bytes, origin: str | None = None):
+    def heartbeat(self):
+        """One behaviour heartbeat + dial any peer-exchange candidates."""
+        self.behaviour.heartbeat()
+        self._dial_px()
+
+    def mesh_peers(self, topic: str) -> set[str]:
+        return self.behaviour.mesh_peers(topic)
+
+    # -- behaviour plumbing ----------------------------------------------
+
+    def on_frame(self, peer_id: str, raw: bytes):
+        """One length-delimited gossip block from a peer's reader."""
+        try:
+            frame = decode_frame(raw)
+        except FrameError:
+            self.service.peers.report(peer_id, SCORE_INVALID_MESSAGE)
+            inc_counter("gossip_invalid_total")
+            return
+        self.behaviour.handle_frame(peer_id, frame)
+
+    def _deliver(self, topic: str, data: bytes, origin: str) -> bool:
         """Validate-then-forward (gossipsub accept/reject semantics): a
         message our handler rejects is NOT relayed, so invalid data never
-        costs downstream peers score."""
-        mid = M.message_id(self.service.spec.message_domain_valid_snappy, data)
-        if not self._first_sight(mid):
+        costs downstream peers score — and the rejection feeds both the
+        gossipsub score (graylisting) and the PeerManager (banning)."""
+        handler = self._handlers.get(topic)
+        if handler is None:
+            return True  # relay-only topic: forwardable, nothing local
+        try:
+            handler(data)
+        except Exception:  # noqa: BLE001 — invalid gossip: reject
+            self.service.peers.report(origin, SCORE_INVALID_MESSAGE)
+            inc_counter("gossip_invalid_total")
+            return False
+        self.service.peers.report(origin, SCORE_TIMELY_MESSAGE)
+        return True
+
+    def _send_frame(self, peer_id: str, payload: bytes):
+        peer = self.service.peers.get(peer_id)
+        if peer is None:
             return
-        inc_counter("gossip_messages_total", topic=topic.split("/")[-2])
-        if origin is not None:
-            handler = self._handlers.get(topic)
-            if handler is not None:
-                try:
-                    handler(data)
-                    self.service.peers.report(origin, SCORE_TIMELY_MESSAGE)
-                except Exception:  # noqa: BLE001 — invalid gossip: reject
-                    self.service.peers.report(origin, SCORE_INVALID_MESSAGE)
-                    inc_counter("gossip_invalid_total")
+        try:
+            with peer.lock:
+                if peer.gossip_sock is None:
                     return
-        for peer in self.service.peers.peers():
-            if peer.peer_id == origin:
+                _send_block(peer.gossip_sock, payload)
+        except OSError:
+            self.service._drop_peer(peer)
+
+    def _px_records(self, topic: str, exclude: str) -> list[tuple[str, str, int]]:
+        """Peer-exchange records for a PRUNE: the topic's other mesh
+        members, addressed as we dialed them."""
+        out = []
+        for pid in self.behaviour.mesh.get(topic, ()):
+            if pid == exclude:
+                continue
+            peer = self.service.peers.get(pid)
+            if peer is not None:
+                out.append((peer.noise_peer_id or pid, peer.host, peer.port))
+        return out
+
+    _PX_DIAL_MAX_PEERS = 8
+
+    def _dial_px(self):
+        """Dial peer-exchange candidates on their own short-lived thread:
+        connect() is a synchronous TCP+handshake and one dead PX record
+        must not stall the heartbeat cadence (mesh repair, IHAVE
+        emission) for a connect timeout."""
+        candidates = self.behaviour.take_px_candidates()
+        if not candidates:
+            return
+        threading.Thread(
+            target=self._dial_px_worker,
+            args=(candidates,),
+            daemon=True,
+            name=f"gossip-px-dial-{self.service.port}",
+        ).start()
+
+    def _dial_px_worker(self, candidates):
+        svc = self.service
+        for _pid, host, port in candidates:
+            if svc._stopping:
+                return
+            have = {(p.host, p.port) for p in svc.peers.peers()}
+            if len(have) >= self._PX_DIAL_MAX_PEERS:
+                return
+            if (host, port) in have or port == svc.port:
                 continue
             try:
-                with peer.lock:
-                    if peer.gossip_sock is None:
-                        continue
-                    _send_block(peer.gossip_sock, _frame_topic(topic) + data)
-            except OSError:
-                self.service._drop_peer(peer)
-
-
-def _frame_topic(topic: str) -> bytes:
-    raw = topic.encode()
-    return bytes([len(raw)]) + raw
-
-
-def _unframe_topic(data: bytes) -> tuple[str, bytes]:
-    n = data[0]
-    return data[1 : 1 + n].decode(), data[1 + n :]
+                svc.connect(host, port)
+            except Exception:  # noqa: BLE001 — dead PX record; move on
+                continue
 
 
 class SyncManager:
@@ -407,6 +494,11 @@ class NetworkService:
     sync manager, and bridges gossip to the beacon chain (the network
     crate's Router + NetworkBeaconProcessor roles in one place)."""
 
+    #: gossipsub heartbeat cadence (config.rs heartbeat_interval is 0.7s;
+    #: shorter here — simulator slots are fast). 0/None disables the
+    #: timer thread (tests drive `gossip.heartbeat()` by hand).
+    HEARTBEAT_INTERVAL = 0.3
+
     def __init__(
         self,
         chain,
@@ -414,6 +506,10 @@ class NetworkService:
         port: int = 0,
         bootnodes=None,
         transport=None,
+        heartbeat_interval: float | None = HEARTBEAT_INTERVAL,
+        gossip_params=None,
+        gossip_thresholds=None,
+        gossip_config=None,
     ):
         self.chain = chain
         self.spec = chain.spec
@@ -422,11 +518,12 @@ class NetworkService:
         # handshake, as the reference's transport builder does
         self.transport = transport
         self.peers = PeerManager()
-        self.gossip = GossipRouter(self)
         self.sync = SyncManager(self)
         self.metadata_seq = 1
         self.server = RpcServer(self, host, port)
         self.port = self.server.port
+        self.heartbeat_interval = heartbeat_interval
+        self._hb_thread = None
         self._stopping = False
         # discv5 analog: advertise our record, bootstrap from bootnodes
         # (None → discovery disabled, as with the reference's --disable-discovery)
@@ -444,8 +541,10 @@ class NetworkService:
         digest = self.fork_digest()
         self.topic_block = M.gossip_topic(digest, M.TOPIC_BEACON_BLOCK)
         # one topic per attestation subnet; a full node stays subscribed
-        # to all of them (the flood model relays everything), while the
-        # SubnetService tracks duty subnets for ENR advertisement
+        # to all of them (it relays every subnet, as the reference's
+        # default subscribe-all-subnets simulator config does), while the
+        # SubnetService tracks duty subnets for ENR advertisement and
+        # eager mesh joins
         self.attestation_topics = {
             i: M.gossip_topic(digest, M.attestation_subnet_topic_name(i))
             for i in range(M.ATTESTATION_SUBNET_COUNT)
@@ -463,6 +562,30 @@ class NetworkService:
             digest, M.TOPIC_SYNC_COMMITTEE
         )
         self.topic_blob_sidecar = M.gossip_topic(digest, M.TOPIC_BLOB_SIDECAR)
+        # scoring parameters are keyed by the node's actual topic strings,
+        # so the router is built only once the topics exist
+        # (gossipsub_scoring_parameters.rs shape)
+        if gossip_params is None:
+            gossip_params = beacon_score_params(
+                self.topic_block,
+                self.topic_aggregate,
+                self.attestation_topics,
+                extra_topics=[
+                    self.topic_exit,
+                    self.topic_proposer_slashing,
+                    self.topic_attester_slashing,
+                    self.topic_sync_committee,
+                    self.topic_blob_sidecar,
+                ],
+            )
+        if gossip_thresholds is None:
+            gossip_thresholds = beacon_score_thresholds()
+        self.gossip = GossipRouter(
+            self,
+            params=gossip_params,
+            thresholds=gossip_thresholds,
+            config=gossip_config,
+        )
         self.gossip.subscribe(self.topic_block, self._on_gossip_block)
         for topic in self.attestation_topics.values():
             self.gossip.subscribe(topic, self._on_gossip_attestation)
@@ -487,7 +610,24 @@ class NetworkService:
         self.server.start()
         if self.discovery is not None:
             self.discovery.start()
+        if self.heartbeat_interval:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                daemon=True,
+                name=f"gossip-heartbeat-{self.port}",
+            )
+            self._hb_thread.start()
         return self
+
+    def _heartbeat_loop(self):
+        while not self._stopping:
+            time.sleep(self.heartbeat_interval)
+            if self._stopping:
+                break
+            try:
+                self.gossip.heartbeat()
+            except Exception as e:  # noqa: BLE001 — heartbeat must outlive faults
+                log.warning("gossip heartbeat failed", error=str(e)[:200])
 
     def discover_and_connect(self, max_peers: int = 8) -> int:
         """One discovery round → dial every new connectable record
@@ -587,6 +727,10 @@ class NetworkService:
                 pass
             client.close()
             raise RpcError("peer is banned")
+        # register with the behaviour (and announce our subscriptions)
+        # BEFORE the reader starts: the remote's SUBSCRIBE frames arrive
+        # immediately and would be dropped for an unknown peer
+        self.gossip.behaviour.add_peer(peer.peer_id)
         t = threading.Thread(
             target=self._gossip_reader,
             args=(peer.gossip_sock, peer.peer_id),
@@ -608,6 +752,7 @@ class NetworkService:
             peer.client.close()  # tear down the muxed RPC connection
         except OSError:
             pass
+        self.gossip.behaviour.remove_peer(peer.peer_id)
         self.peers.remove(peer.peer_id)
 
     # -- gossip plumbing --------------------------------------------------------
@@ -632,6 +777,7 @@ class NetworkService:
             except OSError:
                 pass
             return
+        self.gossip.behaviour.add_peer(peer.peer_id)
         self._gossip_reader(sock, peer.peer_id)
 
     def _gossip_reader(self, sock, peer_id: str):
@@ -651,14 +797,9 @@ class NetworkService:
                 framed = _recv_block(sock, first_byte=first)
             except (RpcError, OSError):
                 break
-            try:
-                topic, data = _unframe_topic(framed)
-            except Exception:  # noqa: BLE001
-                self.peers.report(peer_id, SCORE_INVALID_MESSAGE)
-                continue
             if self.peers.is_banned(peer_id):
                 break  # ban landed while this frame was in flight
-            self.gossip.publish(topic, data, origin=peer_id)
+            self.gossip.on_frame(peer_id, framed)
 
     # -- chain bridging (network_beacon_processor/gossip_methods.rs) ------------
 

@@ -42,13 +42,20 @@ class AttestationSubnetService:
         }
 
     def register_duties(self, duties, epoch: int):
-        """Record duty subnets and refresh the ENR advertisement."""
+        """Record duty subnets, refresh the ENR advertisement, and join
+        the gossipsub mesh for each duty subnet NOW — an attestation due
+        this epoch can't wait for the next heartbeat to find mesh peers
+        (the reference's subscribe-ahead on duty subnets)."""
         subnets = self.subnets_for_duties(duties, epoch)
         self._duty_subnets[epoch] = subnets
         # keep a 2-epoch window (current + next, as the reference does)
         for e in [e for e in self._duty_subnets if e < epoch - 1]:
             del self._duty_subnets[e]
         self._advertise()
+        router = getattr(self.network, "gossip", None)
+        if router is not None:
+            for subnet in sorted(subnets | set(self.persistent_subnets)):
+                router.ensure_mesh(self.network.attestation_topics[subnet])
         return subnets
 
     def active_subnets(self) -> list[int]:

@@ -15,6 +15,7 @@ from enum import Enum
 from ..crypto import bls
 from ..types.chain_spec import FAR_FUTURE_EPOCH, ChainSpec, Domain
 from ..utils.hash import hash32_concat
+from ..utils.tracing import span
 from . import signature_sets as sigsets
 from .accessors import (
     committee_cache_at,
@@ -268,7 +269,12 @@ def _per_block_processing_inner(
             )
         else:
             verifier.include_all_signatures(signed_block, block_root, ctxt)
-        if not verifier.verify():
+        # own span: the signature batch is the stage the TPU backend
+        # accelerates, so bench_block_import can price it separately from
+        # the rest of the (enclosing) state_transition span
+        with span("signature_batch_verify", sets=len(verifier.sets)):
+            sigs_ok = verifier.verify()
+        if not sigs_ok:
             raise BlockProcessingError("bulk signature verification failed")
         # Signatures are done; the per-operation code skips them.
         verify_signatures = False

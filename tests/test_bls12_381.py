@@ -123,11 +123,41 @@ def test_hash_to_g2_properties():
     assert not pt_eq(FQ2, h, hash_to_g2(b"\x22" * 32))
 
 
+def test_interop_keypairs_match_published_vectors():
+    """Validators 0 and 1 of the eth2 interop mocked-start keygen spec
+    (ethereum/eth2.0-pm interop/mocked_start), hand-transcribed — the
+    canonical keys every client's interop docs quote. Independent of both
+    this repo's derivation code and the reference checkout."""
+    vectors = [
+        (
+            "25295f0d1d592a90b333e26e85149708208e9f8e8bc18f6c77bd62f8ad7a6866",
+            "a99a76ed7796f7be22d5b7e85deeb7c5677e88e511e0b337618f8c4eb61349b4"
+            "bf2d153f649f7b53359fe8b94a38e44c",
+        ),
+        (
+            "51d0b65185db6989ab0b560d6deed19c7ead0e24b9b6372cbecb1f26bdfad000",
+            "b89bebc699769726a318c8e9971bd3171297c61aea4a6578a7a4f94b547dcba5"
+            "bac16a89108b6b6a1fe3695d1a874a0b",
+        ),
+    ]
+    kps = bls.interop_keypairs(len(vectors))
+    for i, (sk_hex, pk_hex) in enumerate(vectors):
+        assert kps[i].sk.scalar == int(sk_hex, 16)
+        assert kps[i].pk.to_bytes().hex() == pk_hex
+
+
 def test_interop_keypairs_match_reference_golden_vectors():
-    text = open(
+    """Full 10-validator sweep against the reference checkout's yaml —
+    only runnable where /root/reference is mounted."""
+    path = (
         "/root/reference/common/eth2_interop_keypairs/specs/"
         "keygen_10_validators.yaml"
-    ).read()
+    )
+    import os
+
+    if not os.path.exists(path):
+        pytest.skip("reference checkout not mounted in this environment")
+    text = open(path).read()
     pairs = re.findall(
         r"privkey: '0x([0-9a-f]+)',\s*\n\s*pubkey: '0x([0-9a-f]+)'", text
     )

@@ -22,6 +22,10 @@ from lighthouse_tpu.crypto.bls12_381 import (
 from lighthouse_tpu.crypto.bls12_381.fields import P
 from lighthouse_tpu.ops import bls381 as D
 
+# every test in this file is tier-2: device kernels: XLA-CPU compiles take minutes cold.
+# tests/conftest.py enforces this marker at collection time.
+pytestmark = pytest.mark.slow
+
 
 def test_limb_roundtrip():
     rng = random.Random(0)

@@ -20,6 +20,10 @@ from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# every test in this file is tier-2: device sweep — slow XLA-CPU compile.
+# tests/conftest.py enforces this marker at collection time.
+pytestmark = pytest.mark.slow
+
 _SUBPROC = r"""
 import json, os, sys
 sys.path.insert(0, sys.argv[1])

@@ -21,6 +21,10 @@ from lighthouse_tpu.crypto.kzg import (
     TrustedSetup,
 )
 
+# every test in this file is tier-2: device kernels — XLA-CPU compiles
+# take minutes cold. tests/conftest.py enforces this marker at collection.
+pytestmark = pytest.mark.slow
+
 N = 16  # dev domain size: big enough to exercise folds, small compiles
 rng = random.Random(1234)
 

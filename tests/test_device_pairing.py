@@ -34,6 +34,10 @@ from lighthouse_tpu.ops import bls381_tower as TW
 from lighthouse_tpu.ops.bls381 import g2_points_from_device
 from lighthouse_tpu.ops.bls381_tower import fq2_const
 
+# every test in this file is tier-2: pairing kernels: the slowest compiles in the tree.
+# tests/conftest.py enforces this marker at collection time.
+pytestmark = pytest.mark.slow
+
 rng = random.Random(21)
 
 

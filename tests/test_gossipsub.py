@@ -1,0 +1,716 @@
+"""Gossipsub mesh behaviour + peer scoring, unit level.
+
+Deterministic throughout: the behaviour's only clock is heartbeat ticks,
+the RNG is seeded, and the transport is a recording fake — no sockets,
+no sleeps.
+"""
+
+import hashlib
+
+import pytest
+
+from lighthouse_tpu.network.gossipsub import (
+    GossipsubBehaviour,
+    GossipsubConfig,
+    GraftFrame,
+    IHaveFrame,
+    IWantFrame,
+    MessageCache,
+    PeerScore,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    PruneFrame,
+    PublishFrame,
+    SubscriptionFrame,
+    TopicScoreParams,
+    decode_frame,
+    encode_frame,
+)
+
+TOPIC = "/eth2/00000000/beacon_block/ssz_snappy"
+
+
+def mid_of(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()[:20]
+
+
+# ---------------------------------------------------------------------------
+# mcache
+# ---------------------------------------------------------------------------
+
+
+def test_mcache_gossip_window_and_expiry():
+    mc = MessageCache(history_length=3, gossip_window=2)
+    mc.put(b"a" * 20, "t", b"da")
+    mc.shift()
+    mc.put(b"b" * 20, "t", b"db")
+    mc.shift()
+    mc.put(b"c" * 20, "t", b"dc")
+    # gossip window (2 newest windows): c and b, not a
+    assert set(mc.gossip_ids("t")) == {b"b" * 20, b"c" * 20}
+    # but a is still answerable from full history
+    assert mc.get(b"a" * 20) == ("t", b"da")
+    mc.shift()  # a's window falls off history (3 windows kept)
+    assert mc.get(b"a" * 20) is None
+    assert mc.get(b"b" * 20) == ("t", b"db")
+
+
+def test_mcache_topics_are_separate():
+    mc = MessageCache()
+    mc.put(b"a" * 20, "t1", b"x")
+    mc.put(b"b" * 20, "t2", b"y")
+    assert mc.gossip_ids("t1") == [b"a" * 20]
+    assert mc.gossip_ids("t2") == [b"b" * 20]
+    assert mc.topics_in_gossip_window() == {"t1", "t2"}
+
+
+def test_mcache_retransmission_cap_is_per_requester():
+    mc = MessageCache()
+    mc.put(b"a" * 20, "t", b"x")
+    for _ in range(3):
+        assert mc.get_for_iwant(b"a" * 20, "p1", limit=3) == ("t", b"x")
+    # anti-spam: after `limit` serves THIS requester is refused...
+    assert mc.get_for_iwant(b"a" * 20, "p1", limit=3) is None
+    # ...but an honest different requester still gets the message
+    # (a global count would break its promise and penalize US)
+    assert mc.get_for_iwant(b"a" * 20, "p2", limit=3) == ("t", b"x")
+    assert mc.get(b"a" * 20) is not None  # plain get unaffected
+
+
+# ---------------------------------------------------------------------------
+# score engine
+# ---------------------------------------------------------------------------
+
+
+def _params(**topic_kw) -> PeerScoreParams:
+    return PeerScoreParams(topics={"t": TopicScoreParams(**topic_kw)})
+
+
+def test_score_p1_time_in_mesh_accrues_only_in_mesh():
+    ps = PeerScore(_params(time_in_mesh_weight=0.5, time_in_mesh_cap=4))
+    ps.add_peer("p")
+    ps.graft("p", "t")
+    for _ in range(3):
+        ps.refresh()
+    assert ps.score("p") == pytest.approx(0.5 * 3)
+    for _ in range(10):
+        ps.refresh()
+    assert ps.score("p") == pytest.approx(0.5 * 4)  # capped
+    ps.prune("p", "t")
+    assert ps.score("p") == 0.0  # P1 stops counting outside the mesh
+
+
+def test_score_p2_first_deliveries_accumulate_cap_and_decay():
+    ps = PeerScore(
+        _params(
+            first_message_deliveries_weight=2.0,
+            first_message_deliveries_cap=5.0,
+            first_message_deliveries_decay=0.5,
+        )
+    )
+    ps.add_peer("p")
+    for _ in range(8):
+        ps.first_delivery("p", "t")
+    assert ps.score("p") == pytest.approx(2.0 * 5.0)  # capped at 5
+    ps.refresh()
+    assert ps.score("p") == pytest.approx(2.0 * 2.5)  # decayed
+    for _ in range(12):
+        ps.refresh()
+    assert ps.score("p") == 0.0  # decay_to_zero snaps
+
+
+def test_score_p3_mesh_delivery_deficit_squared_after_activation():
+    ps = PeerScore(
+        _params(
+            time_in_mesh_weight=0.0,
+            first_message_deliveries_weight=0.0,
+            mesh_message_deliveries_weight=-1.0,
+            mesh_message_deliveries_threshold=4.0,
+            mesh_message_deliveries_activation=2,
+            mesh_message_deliveries_decay=1.0,
+        )
+    )
+    ps.add_peer("p")
+    ps.graft("p", "t")
+    assert ps.score("p") == 0.0  # not yet active
+    ps.refresh()
+    ps.refresh()  # mesh_time = 2 = activation
+    assert ps.score("p") == pytest.approx(-16.0)  # (4-0)^2
+    ps.first_delivery("p", "t")
+    ps.first_delivery("p", "t")
+    assert ps.score("p") == pytest.approx(-4.0)  # (4-2)^2
+    ps.duplicate_delivery("p", "t")
+    ps.duplicate_delivery("p", "t")
+    assert ps.score("p") == 0.0  # quota met (duplicates count in-mesh)
+
+
+def test_score_p4_invalid_messages_squared():
+    ps = PeerScore(_params(invalid_message_deliveries_weight=-2.0))
+    ps.add_peer("p")
+    for i, expected in [(1, -2.0), (2, -8.0), (3, -18.0)]:
+        ps.invalid_message("p", "t")
+        assert ps.score("p") == pytest.approx(expected), i
+
+
+def test_score_p7_behaviour_penalty_and_decay():
+    ps = PeerScore(
+        PeerScoreParams(behaviour_penalty_weight=-5.0, behaviour_penalty_decay=0.5)
+    )
+    ps.add_peer("p")
+    ps.behaviour_penalty("p")
+    ps.behaviour_penalty("p")
+    assert ps.score("p") == pytest.approx(-20.0)  # -5 * 2^2
+    ps.refresh()
+    assert ps.score("p") == pytest.approx(-5.0)  # -5 * 1^2
+
+
+def test_score_positive_topics_capped_negatives_not():
+    params = PeerScoreParams(
+        topics={
+            "a": TopicScoreParams(
+                topic_weight=1.0, first_message_deliveries_weight=10.0
+            ),
+            "b": TopicScoreParams(
+                topic_weight=1.0, invalid_message_deliveries_weight=-10.0
+            ),
+        },
+        topic_score_cap=25.0,
+    )
+    ps = PeerScore(params)
+    ps.add_peer("p")
+    for _ in range(10):
+        ps.first_delivery("p", "a")  # +100 uncapped, 25 capped
+    assert ps.score("p") == pytest.approx(25.0)
+    ps.invalid_message("p", "b")  # -10, applied beyond the cap
+    assert ps.score("p") == pytest.approx(15.0)
+
+
+# ---------------------------------------------------------------------------
+# behaviour harness
+# ---------------------------------------------------------------------------
+
+
+class Net:
+    """Recording transport + always-valid (configurable) delivery."""
+
+    def __init__(self, **cfg_kw):
+        self.sent: list[tuple[str, object]] = []
+        self.delivered: list[tuple[str, bytes, str]] = []
+        self.valid = True
+        cfg = GossipsubConfig(**cfg_kw)
+        self.b = GossipsubBehaviour(
+            send=lambda pid, raw: self.sent.append((pid, decode_frame(raw))),
+            deliver=self._deliver,
+            mid_fn=mid_of,
+            px_provider=lambda topic, exclude: [
+                (p, "10.0.0.1", 4000)
+                for p in self.b.mesh.get(topic, ())
+                if p != exclude
+            ],
+            thresholds=PeerScoreThresholds(
+                gossip_threshold=-40,
+                publish_threshold=-60,
+                graylist_threshold=-80,
+                accept_px_threshold=10,
+            ),
+            config=cfg,
+            seed=1234,
+        )
+
+    def _deliver(self, topic, data, origin):
+        self.delivered.append((topic, data, origin))
+        return self.valid
+
+    def add_subscribed_peer(self, pid, topic=TOPIC):
+        self.b.add_peer(pid)
+        self.b.handle_frame(
+            pid, SubscriptionFrame(subscribe=True, topic=topic.encode())
+        )
+
+    def frames_to(self, pid, cls):
+        return [f for p, f in self.sent if p == pid and isinstance(f, cls)]
+
+    def clear(self):
+        self.sent.clear()
+
+
+def test_add_peer_announces_subscriptions():
+    net = Net()
+    net.b.subscribe(TOPIC)
+    net.b.add_peer("p1")
+    subs = net.frames_to("p1", SubscriptionFrame)
+    assert [bytes(s.topic).decode() for s in subs] == [TOPIC]
+    assert all(bool(s.subscribe) for s in subs)
+
+
+def test_heartbeat_grafts_up_to_d():
+    net = Net(d=3, d_lo=2, d_hi=6)
+    net.b.subscribe(TOPIC)
+    for i in range(8):
+        net.add_subscribed_peer(f"p{i}")
+    net.clear()
+    net.b.heartbeat()
+    grafted = {p for p, f in net.sent if isinstance(f, GraftFrame)}
+    assert len(grafted) == 3
+    assert net.b.mesh_peers(TOPIC) == grafted
+
+
+def test_graft_refused_when_mesh_full():
+    net = Net(d=2, d_lo=1, d_hi=3)
+    net.b.subscribe(TOPIC)
+    for i in range(3):
+        net.add_subscribed_peer(f"p{i}")
+        net.b.handle_frame(f"p{i}", GraftFrame(topic=TOPIC.encode()))
+    assert len(net.b.mesh_peers(TOPIC)) == 3
+    net.clear()
+    net.add_subscribed_peer("p3")
+    net.b.handle_frame("p3", GraftFrame(topic=TOPIC.encode()))
+    assert "p3" not in net.b.mesh_peers(TOPIC)
+    assert net.frames_to("p3", PruneFrame)  # refused: mesh at d_hi
+
+
+def test_heartbeat_prunes_oversized_mesh_keeping_best_scores():
+    net = Net(d=3, d_lo=2, d_hi=4, d_score=2)
+    net.b.subscribe(TOPIC)
+    for i in range(6):
+        pid = f"p{i}"
+        net.add_subscribed_peer(pid)
+        # force everyone into the mesh directly (inbound GRAFTs would be
+        # refused past d_hi — that refusal has its own test above)
+        net.b.mesh[TOPIC].add(pid)
+        net.b.score.graft(pid, TOPIC)
+    assert len(net.b.mesh_peers(TOPIC)) == 6  # > d_hi
+    # give p0/p1 the best scores: deliveries
+    for _ in range(5):
+        net.b.score.first_delivery("p0", TOPIC)
+        net.b.score.first_delivery("p1", TOPIC)
+    net.clear()
+    net.b.heartbeat()
+    mesh = net.b.mesh_peers(TOPIC)
+    assert len(mesh) == 3  # back to D
+    assert {"p0", "p1"} <= mesh  # d_score best retained deterministically
+    pruned = {p for p, f in net.sent if isinstance(f, PruneFrame)}
+    assert pruned == {f"p{i}" for i in range(6)} - mesh
+    # pruned peers are under backoff: the next heartbeat must not re-graft
+    net.clear()
+    net.b.heartbeat()
+    assert not any(isinstance(f, GraftFrame) for _, f in net.sent)
+
+
+def test_prune_carries_backoff_and_px():
+    net = Net(d=2, d_lo=1, d_hi=3, d_score=1, prune_backoff=7)
+    net.b.subscribe(TOPIC)
+    for i in range(5):
+        pid = f"p{i}"
+        net.add_subscribed_peer(pid)
+        net.b.mesh[TOPIC].add(pid)
+        net.b.score.graft(pid, TOPIC)
+    net.clear()
+    net.b.heartbeat()
+    prunes = [f for _, f in net.sent if isinstance(f, PruneFrame)]
+    assert prunes
+    for pf in prunes:
+        assert int(pf.backoff) == 7
+        assert len(pf.px) >= 1  # peer exchange carried on mesh prunes
+
+
+def test_graft_rejected_during_backoff_with_penalty():
+    net = Net(prune_backoff=10)
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("p0")
+    net.b.backoff[(TOPIC, "p0")] = net.b.ticks + 10
+    net.clear()
+    net.b.handle_frame("p0", GraftFrame(topic=TOPIC.encode()))
+    assert net.frames_to("p0", PruneFrame)  # refused
+    assert "p0" not in net.b.mesh_peers(TOPIC)
+    assert net.b.peer_score("p0") < 0  # P7 backoff-violation penalty
+
+
+def test_graft_from_negative_score_peer_refused():
+    net = Net()
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("bad")
+    net.b.score.invalid_message("bad", TOPIC)  # score < 0
+    net.clear()
+    net.b.handle_frame("bad", GraftFrame(topic=TOPIC.encode()))
+    assert net.frames_to("bad", PruneFrame)
+    assert "bad" not in net.b.mesh_peers(TOPIC)
+
+
+def test_negative_score_mesh_member_pruned_on_heartbeat():
+    net = Net(d=3, d_lo=2)
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("p0")
+    net.b.handle_frame("p0", GraftFrame(topic=TOPIC.encode()))
+    assert "p0" in net.b.mesh_peers(TOPIC)
+    net.b.score.invalid_message("p0", TOPIC)
+    net.clear()
+    net.b.heartbeat()
+    assert "p0" not in net.b.mesh_peers(TOPIC)
+    assert net.frames_to("p0", PruneFrame)
+
+
+def test_publish_floods_to_subscribed_above_publish_threshold():
+    net = Net()
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("good")
+    net.add_subscribed_peer("awful")
+    net.add_subscribed_peer("other-topic")
+    net.b.handle_frame(
+        "other-topic", SubscriptionFrame(subscribe=False, topic=TOPIC.encode())
+    )
+    # push "awful" below the publish threshold (-60): 6 invalids at -2·n²
+    for _ in range(6):
+        net.b.score.invalid_message("awful", TOPIC)
+    net.clear()
+    net.b.publish(TOPIC, b"block-bytes")
+    targets = {p for p, f in net.sent if isinstance(f, PublishFrame)}
+    assert targets == {"good"}
+    assert net.b.mcache.get(mid_of(b"block-bytes")) == (TOPIC, b"block-bytes")
+
+
+def test_remote_publish_validates_forwards_and_scores():
+    net = Net(d=2, d_lo=1)
+    net.b.subscribe(TOPIC)
+    for pid in ("origin", "m1", "m2"):
+        net.add_subscribed_peer(pid)
+        net.b.handle_frame(pid, GraftFrame(topic=TOPIC.encode()))
+    net.clear()
+    net.b.handle_frame(
+        "origin", PublishFrame(topic=TOPIC.encode(), data=b"payload")
+    )
+    assert net.delivered == [(TOPIC, b"payload", "origin")]
+    fwd = {p for p, f in net.sent if isinstance(f, PublishFrame)}
+    assert fwd == {"m1", "m2"}  # mesh minus origin
+    assert net.b.peer_score("origin") > 0  # P2 first delivery
+    # duplicate: not re-delivered, not re-forwarded
+    net.clear()
+    net.b.handle_frame(
+        "m1", PublishFrame(topic=TOPIC.encode(), data=b"payload")
+    )
+    assert len(net.delivered) == 1
+    assert not net.sent
+
+
+def test_invalid_remote_publish_not_forwarded_and_penalized():
+    net = Net(d=2, d_lo=1)
+    net.b.subscribe(TOPIC)
+    for pid in ("origin", "m1"):
+        net.add_subscribed_peer(pid)
+        net.b.handle_frame(pid, GraftFrame(topic=TOPIC.encode()))
+    net.valid = False
+    net.clear()
+    net.b.handle_frame(
+        "origin", PublishFrame(topic=TOPIC.encode(), data=b"garbage")
+    )
+    assert not any(isinstance(f, PublishFrame) for _, f in net.sent)
+    assert net.b.peer_score("origin") < 0
+
+
+def test_graylisted_peer_is_ignored_entirely():
+    net = Net()
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("evil")
+    # drive below graylist (-80): 7 invalids → -2·49 = -98
+    for _ in range(7):
+        net.b.score.invalid_message("evil", TOPIC)
+    assert net.b.peer_score("evil") < -80
+    net.clear()
+    before = len(net.delivered)
+    net.b.handle_frame(
+        "evil", PublishFrame(topic=TOPIC.encode(), data=b"whatever")
+    )
+    net.b.handle_frame("evil", GraftFrame(topic=TOPIC.encode()))
+    assert len(net.delivered) == before  # never validated
+    assert not net.sent  # not even a PRUNE back
+    assert "evil" not in net.b.mesh_peers(TOPIC)
+
+
+def test_heartbeat_emits_ihave_to_nonmesh_peers_only():
+    net = Net(d=2, d_lo=1, d_lazy=5, gossip_window=3)
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("meshed")
+    net.b.handle_frame("meshed", GraftFrame(topic=TOPIC.encode()))
+    net.add_subscribed_peer("lazy1")
+    net.add_subscribed_peer("lazy2")
+    net.b.publish(TOPIC, b"m1")
+    net.b.publish(TOPIC, b"m2")
+    net.clear()
+    # keep lazy peers out of the mesh for this heartbeat so gossip
+    # targeting is observable
+    net.b.mesh[TOPIC] = {"meshed"}
+    net.b.config.d_lo = 0  # no grafting this round
+    net.b.heartbeat()
+    ihave_targets = {p for p, f in net.sent if isinstance(f, IHaveFrame)}
+    assert ihave_targets == {"lazy1", "lazy2"}
+    for _, f in net.sent:
+        if isinstance(f, IHaveFrame):
+            assert {bytes(m) for m in f.message_ids} == {
+                mid_of(b"m1"),
+                mid_of(b"m2"),
+            }
+
+
+def test_ihave_triggers_iwant_and_tracks_promise():
+    net = Net()
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("p0")
+    missing = mid_of(b"unseen")
+    known = mid_of(b"seen")
+    net.b.publish(TOPIC, b"seen")
+    net.clear()
+    net.b.handle_frame(
+        "p0", IHaveFrame(topic=TOPIC.encode(), message_ids=[missing, known])
+    )
+    [iw] = net.frames_to("p0", IWantFrame)
+    assert [bytes(m) for m in iw.message_ids] == [missing]  # only the unseen
+    assert missing in net.b._promises
+    # repeated IHAVE for an already-promised mid sends nothing new
+    net.clear()
+    net.b.handle_frame(
+        "p0", IHaveFrame(topic=TOPIC.encode(), message_ids=[missing])
+    )
+    assert not net.frames_to("p0", IWantFrame)
+
+
+def test_broken_iwant_promise_costs_behaviour_penalty():
+    net = Net(iwant_promise_ticks=2)
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("flaky")
+    net.b.handle_frame(
+        "flaky",
+        IHaveFrame(topic=TOPIC.encode(), message_ids=[mid_of(b"ghost")]),
+    )
+    assert net.b.peer_score("flaky") == 0.0
+    net.b.heartbeat()
+    net.b.heartbeat()  # promise deadline passes, message never arrived
+    assert net.b.peer_score("flaky") < 0
+    assert not net.b._promises
+
+
+def test_kept_promise_is_not_penalized():
+    net = Net(iwant_promise_ticks=2)
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("honest")
+    net.b.handle_frame(
+        "honest",
+        IHaveFrame(topic=TOPIC.encode(), message_ids=[mid_of(b"late-msg")]),
+    )
+    net.b.handle_frame(
+        "honest", PublishFrame(topic=TOPIC.encode(), data=b"late-msg")
+    )
+    net.b.heartbeat()
+    net.b.heartbeat()
+    assert net.b.peer_score("honest") > 0  # first delivery, no penalty
+
+
+def test_iwant_served_from_mcache_with_retransmission_cap():
+    net = Net(gossip_retransmission=2)
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("asker")
+    net.b.publish(TOPIC, b"stored")
+    mid = mid_of(b"stored")
+    for i in range(2):
+        net.clear()
+        net.b.handle_frame("asker", IWantFrame(message_ids=[mid]))
+        [pub] = net.frames_to("asker", PublishFrame)
+        assert bytes(pub.data) == b"stored", i
+    net.clear()
+    net.b.handle_frame("asker", IWantFrame(message_ids=[mid]))
+    assert not net.frames_to("asker", PublishFrame)  # cap reached
+
+
+def test_prune_with_px_records_candidates_only_above_threshold():
+    from lighthouse_tpu.network.gossipsub import PeerRecord
+
+    net = Net()
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("pruner")
+    frame = PruneFrame(
+        topic=TOPIC.encode(),
+        backoff=5,
+        px=[PeerRecord(peer_id=b"cand", host=b"10.0.0.9", port=4000)],
+    )
+    # zero-score pruner is below accept_px_threshold (10): PX refused
+    net.b.handle_frame("pruner", frame)
+    assert net.b.take_px_candidates() == []
+    # raise pruner above the threshold: 11 first-deliveries
+    for _ in range(11):
+        net.b.score.first_delivery("pruner", TOPIC)
+    net.b.handle_frame("pruner", frame)
+    assert net.b.take_px_candidates() == [("cand", "10.0.0.9", 4000)]
+    # backoff recorded against the pruner
+    assert net.b.backoff[(TOPIC, "pruner")] > net.b.ticks
+
+
+def test_unsubscribe_prunes_mesh_and_announces():
+    net = Net()
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("p0")
+    net.b.handle_frame("p0", GraftFrame(topic=TOPIC.encode()))
+    net.clear()
+    net.b.unsubscribe(TOPIC)
+    assert net.frames_to("p0", PruneFrame)
+    subs = net.frames_to("p0", SubscriptionFrame)
+    assert subs and not bool(subs[-1].subscribe)
+    assert TOPIC not in net.b.subscriptions
+
+
+def test_opportunistic_graft_when_mesh_median_sags():
+    net = Net(d=3, d_lo=2, d_hi=6, opportunistic_graft_ticks=1)
+    net.b.subscribe(TOPIC)
+    for pid in ("sad1", "sad2"):
+        net.add_subscribed_peer(pid)
+        net.b.handle_frame(pid, GraftFrame(topic=TOPIC.encode()))
+        # slightly negative-adjacent: low but valid (0 score would block
+        # nothing; use delivered-then-decayed peers instead)
+    # two fresh peers with strong scores, outside the mesh
+    for pid in ("star1", "star2"):
+        net.add_subscribed_peer(pid)
+        for _ in range(5):
+            net.b.score.first_delivery(pid, TOPIC)
+    net.clear()
+    net.b.heartbeat()
+    mesh = net.b.mesh_peers(TOPIC)
+    # mesh median (0.x from the sad pair) < opportunistic threshold (1.0)
+    # → at least one star grafted on top of normal fill
+    assert mesh & {"star1", "star2"}
+
+
+def test_graft_now_fills_mesh_immediately():
+    net = Net(d=2)
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("p0")
+    net.add_subscribed_peer("p1")
+    net.clear()
+    net.b.graft_now(TOPIC)
+    assert len(net.b.mesh_peers(TOPIC)) == 2
+    assert len([f for _, f in net.sent if isinstance(f, GraftFrame)]) == 2
+
+
+def test_frame_encode_decode_symmetry_through_wire():
+    # behaviour output is decodable by a second behaviour (wire sanity)
+    net_a, net_b = Net(), Net()
+    net_a.b.subscribe(TOPIC)
+    net_b.b.subscribe(TOPIC)
+    raw_frames: list[bytes] = []
+    net_a.b._send = lambda pid, raw: raw_frames.append(raw)
+    net_a.b.add_peer("b")
+    net_a.b.handle_frame(
+        "b", SubscriptionFrame(subscribe=True, topic=TOPIC.encode())
+    )
+    net_a.b.publish(TOPIC, b"cross")
+    net_b.b.add_peer("a")
+    for raw in raw_frames:
+        net_b.b.handle_frame("a", decode_frame(raw))
+    assert net_b.b.peer_topics["a"] == {TOPIC}
+    assert net_b.b.seen(mid_of(b"cross"))
+
+
+def test_publish_on_unsubscribed_topic_dropped_without_credit():
+    net = Net()
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("spammer")
+    net.clear()
+    net.b.handle_frame(
+        "spammer", PublishFrame(topic=b"/junk/topic", data=b"x" * 1000)
+    )
+    assert net.delivered == []  # never validated
+    assert not net.sent  # never forwarded
+    assert net.b.peer_score("spammer") == 0.0  # no P2 farming
+    assert net.b.mcache.get(mid_of(b"x" * 1000)) is None  # never cached
+
+
+def test_remote_prune_backoff_clamped_and_cleared_on_disconnect():
+    net = Net(prune_backoff=10)  # clamp = 10 * max_backoff_factor(4) = 40
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("p0")
+    net.b.handle_frame(
+        "p0", PruneFrame(topic=TOPIC.encode(), backoff=2**60, px=[])
+    )
+    assert net.b.backoff[(TOPIC, "p0")] <= net.b.ticks + 40  # not permanent
+    net.b.remove_peer("p0")
+    assert (TOPIC, "p0") not in net.b.backoff  # no leak for cheap peer ids
+
+
+def test_duplicate_graft_does_not_reset_mesh_time():
+    net = Net()
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("p0")
+    net.b.handle_frame("p0", GraftFrame(topic=TOPIC.encode()))
+    for _ in range(3):
+        net.b.score.refresh()  # mesh_time = 3
+    before = net.b.peer_score("p0")
+    assert before > 0  # P1 accrued
+    net.b.handle_frame("p0", GraftFrame(topic=TOPIC.encode()))  # duplicate
+    assert net.b.peer_score("p0") == before  # clock NOT reset
+    assert "p0" in net.b.mesh_peers(TOPIC)
+
+
+def test_ihave_budget_per_peer_per_heartbeat():
+    net = Net(max_ihave_messages=2)
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("p0")
+    for i in range(4):
+        net.b.handle_frame(
+            "p0",
+            IHaveFrame(
+                topic=TOPIC.encode(), message_ids=[mid_of(b"m%d" % i)]
+            ),
+        )
+    # only the first 2 frames in this heartbeat elicited IWANTs
+    assert len(net.frames_to("p0", IWantFrame)) == 2
+    assert len(net.b._promises) == 2
+    net.clear()
+    net.b.heartbeat()  # budget resets
+    net.b.handle_frame(
+        "p0", IHaveFrame(topic=TOPIC.encode(), message_ids=[mid_of(b"m9")])
+    )
+    assert len(net.frames_to("p0", IWantFrame)) == 1
+
+
+def test_junk_topic_frames_create_no_per_peer_state():
+    net = Net()
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("spammer")
+    topics_before = set(net.b.peer_topics["spammer"])
+    net.clear()
+    net.b.handle_frame("spammer", GraftFrame(topic=b"/junk/t1"))
+    net.b.handle_frame(
+        "spammer", PruneFrame(topic=b"/junk/t2", backoff=5, px=[])
+    )
+    # GRAFT on an unknown topic is refused with a PRUNE, and neither
+    # frame grew peer_topics / backoff / score stats
+    assert net.frames_to("spammer", PruneFrame)
+    assert net.b.peer_topics["spammer"] == topics_before
+    assert not any(k[0].startswith("/junk/") for k in net.b.backoff)
+    assert net.b.peer_score("spammer") == 0.0
+
+
+def test_peer_topics_capped_against_subscription_floods():
+    net = Net()
+    net.b.add_peer("spammer")
+    for i in range(net.b.MAX_PEER_TOPICS + 100):
+        net.b.handle_frame(
+            "spammer",
+            SubscriptionFrame(subscribe=True, topic=b"/junk/%d" % i),
+        )
+    assert len(net.b.peer_topics["spammer"]) == net.b.MAX_PEER_TOPICS
+
+
+def test_frames_racing_disconnect_leave_no_ghost_state():
+    net = Net()
+    net.b.subscribe(TOPIC)
+    net.add_subscribed_peer("gone")
+    net.b.remove_peer("gone")
+    net.b.handle_frame(
+        "gone", SubscriptionFrame(subscribe=True, topic=TOPIC.encode())
+    )
+    net.b.handle_frame("gone", GraftFrame(topic=TOPIC.encode()))
+    net.b.handle_frame(
+        "gone", PruneFrame(topic=TOPIC.encode(), backoff=5, px=[])
+    )
+    assert "gone" not in net.b.peer_topics
+    assert "gone" not in net.b.mesh_peers(TOPIC)
+    assert not net.b.score.known("gone")
+    assert not any(k[1] == "gone" for k in net.b.backoff)

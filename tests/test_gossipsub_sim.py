@@ -1,0 +1,251 @@
+"""Gossipsub mesh in the live multi-node network (acceptance scenario).
+
+Scenario 1: a misbehaving peer floods garbage blocks; its gossipsub score
+crosses the graylist threshold on every honest node, the next heartbeat
+PRUNEs it from their meshes (with backoff recorded on both sides), its
+subsequent frames are dropped before validation — and honest block gossip
+keeps flowing between the remaining nodes.
+
+Scenario 2: lazy-pull recovery — a node that missed a block's eager push
+entirely (it wasn't connected when the block was published, and its
+scores keep it out of the publisher's mesh) recovers the block purely via
+heartbeat IHAVE → IWANT → PUBLISH.
+"""
+
+import time
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.metrics import REGISTRY
+from lighthouse_tpu.network import BAN_THRESHOLD, NetworkService
+from lighthouse_tpu.network.gossipsub import PeerScoreThresholds
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+
+def _harness(slots=0):
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, E, validator_count=16)
+    if slots:
+        h.extend_chain(slots)
+    return h
+
+
+def _wait(predicate, timeout=5.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+#: strict thresholds for the scenario: 3 invalid blocks (-2·3² = -18 on
+#: the block topic) cross ALL of them, while the PeerManager's ban
+#: (4 × -10 vs -40) does NOT fire — isolating the gossipsub response
+STRICT = PeerScoreThresholds(
+    gossip_threshold=-10.0,
+    publish_threshold=-12.0,
+    graylist_threshold=-15.0,
+    accept_px_threshold=10.0,
+    opportunistic_graft_threshold=1.0,
+)
+
+
+def test_misbehaving_peer_graylisted_pruned_and_ignored():
+    a = _harness(slots=E.SLOTS_PER_EPOCH)
+    b = _harness()
+    m = _harness()
+    na = NetworkService(a.chain, heartbeat_interval=0, gossip_thresholds=STRICT)
+    nb = NetworkService(b.chain, heartbeat_interval=0, gossip_thresholds=STRICT)
+    nm = NetworkService(m.chain, heartbeat_interval=0)
+    for n in (na, nb, nm):
+        n.start()
+    try:
+        b.slot_clock.set_slot(a.chain.head_state.slot)
+        m.slot_clock.set_slot(a.chain.head_state.slot)
+        peer_ab = nb.connect("127.0.0.1", na.port)
+        nb.sync.sync_with(peer_ab)
+        peer_mb = nm.connect("127.0.0.1", na.port)
+        nm.sync.sync_with(peer_mb)
+        nm.connect("127.0.0.1", nb.port)
+        m_id_at_a = f"127.0.0.1:{nm.port}"
+        m_id_at_b = f"127.0.0.1:{nm.port}"
+        b_id_at_a = f"127.0.0.1:{nb.port}"
+        topic = na.topic_block
+
+        # subscriptions must have propagated BOTH ways before meshes can
+        # form (and before M's floods have any targets)
+        a_id_at_m = f"127.0.0.1:{na.port}"
+        b_id_at_m = f"127.0.0.1:{nb.port}"
+        for svc, pid in (
+            (na, m_id_at_a),
+            (na, b_id_at_a),
+            (nb, m_id_at_b),
+            (nm, a_id_at_m),
+            (nm, b_id_at_m),
+        ):
+            _wait(
+                lambda s=svc, p=pid: topic
+                in s.gossip.behaviour.peer_topics.get(p, ()),
+                what=f"subscription of {pid}",
+            )
+        for n in (na, nb, nm):
+            n.gossip.heartbeat()
+        assert m_id_at_a in na.gossip.mesh_peers(topic)
+        assert m_id_at_b in nb.gossip.mesh_peers(topic)
+
+        # -- misbehave: 3 undecodable blocks flood-published by M --------
+        for i in range(3):
+            nm.gossip.publish(nm.topic_block, b"garbage-block-%d" % i)
+        for svc, pid in ((na, m_id_at_a), (nb, m_id_at_b)):
+            _wait(
+                lambda s=svc, p=pid: s.gossip.behaviour.peer_score(p)
+                < STRICT.graylist_threshold,
+                what=f"graylist crossing at {pid}",
+            )
+        # the PeerManager saw 3 invalid reports (-30): demoted, NOT banned
+        # — the mesh response below is gossipsub's own
+        mgr_peer = na.peers.get(m_id_at_a)
+        assert mgr_peer is not None and not mgr_peer.banned
+        assert BAN_THRESHOLD < mgr_peer.score <= -30.0
+
+        # -- heartbeat: negative-score member is PRUNEd with backoff -----
+        na.gossip.heartbeat()
+        nb.gossip.heartbeat()
+        assert m_id_at_a not in na.gossip.mesh_peers(topic)
+        assert m_id_at_b not in nb.gossip.mesh_peers(topic)
+        assert na.gossip.behaviour.backoff.get((topic, m_id_at_a), 0) > 0
+        # M received the PRUNEs and recorded its own backoff against both
+        _wait(
+            lambda: (topic, a_id_at_m) in nm.gossip.behaviour.backoff
+            and (topic, b_id_at_m) in nm.gossip.behaviour.backoff,
+            what="PRUNE backoff recorded on the misbehaving node",
+        )
+
+        # -- graylisted: further frames dropped before validation --------
+        dropped = REGISTRY.counter("gossipsub_graylist_dropped_total")
+        before_drops = dropped.value()
+        before_mgr_score = mgr_peer.score
+        nm.gossip.publish(nm.topic_block, b"garbage-block-99")
+        _wait(
+            lambda: dropped.value() >= before_drops + 2,  # dropped at A and B
+            what="graylist drops counted",
+        )
+        assert mgr_peer.score == before_mgr_score  # handler never ran
+
+        # -- honest gossip still flows ----------------------------------
+        slot = a.chain.head_state.slot + 1
+        for h in (a, b, m):
+            h.slot_clock.set_slot(slot)
+        root, signed = a.add_block_at_slot(slot)
+        na.publish_block(signed)
+        _wait(lambda: b.chain.head_root == root, what="honest propagation")
+        # the graylisted peer was excluded from the flood and both meshes
+        assert m.chain.head_root != root
+    finally:
+        for n in (na, nb, nm):
+            n.stop()
+
+
+def test_late_joiner_recovers_block_via_ihave_iwant():
+    a = _harness(slots=4)
+    c = _harness()
+    na = NetworkService(a.chain, heartbeat_interval=0)
+    nc = NetworkService(c.chain, heartbeat_interval=0)
+    na.start()
+    nc.start()
+    try:
+        # replicate A's chain into C out-of-band (RPC, not gossip)
+        c.slot_clock.set_slot(a.chain.head_state.slot)
+        blocks = na.blocks_by_range(1, a.chain.head_state.slot)
+        result = c.chain.process_chain_segment(blocks)
+        assert result.error is None and c.chain.head_root == a.chain.head_root
+
+        # A produces and publishes a block while C is NOT connected: the
+        # eager push misses C entirely; only A's mcache remembers it
+        slot = a.chain.head_state.slot + 1
+        a.slot_clock.set_slot(slot)
+        c.slot_clock.set_slot(slot)
+        root, signed = a.add_block_at_slot(slot)
+        na.publish_block(signed)
+        assert c.chain.head_root != root
+
+        nc.connect("127.0.0.1", na.port)
+        c_id = f"127.0.0.1:{nc.port}"
+        topic = na.topic_block
+        _wait(
+            lambda: topic in na.gossip.behaviour.peer_topics.get(c_id, ()),
+            what="late joiner's subscription",
+        )
+        # keep C out of A's mesh (score < 0) but above the gossip
+        # threshold (-40): mesh-ineligible peers are exactly who lazy
+        # gossip exists for
+        na.gossip.behaviour.score.behaviour_penalty(c_id)
+        assert -40 < na.gossip.behaviour.peer_score(c_id) < 0
+
+        served = REGISTRY.counter("gossipsub_iwant_served_total")
+        before = served.value()
+        na.gossip.heartbeat()  # emits IHAVE to C; C pulls via IWANT
+        _wait(lambda: c.chain.head_root == root, what="IHAVE/IWANT recovery")
+        assert c_id not in na.gossip.mesh_peers(topic)  # never eager-pushed
+        assert served.value() >= before + 1
+    finally:
+        na.stop()
+        nc.stop()
+
+
+def test_px_records_dialed_after_prune():
+    """v1.1 peer exchange: a node pruned from an over-sized mesh learns
+    replacement peers from the PRUNE and dials one."""
+    a = _harness(slots=2)
+    b = _harness()
+    c = _harness()
+    na = NetworkService(a.chain, heartbeat_interval=0)
+    nb = NetworkService(b.chain, heartbeat_interval=0)
+    nc = NetworkService(c.chain, heartbeat_interval=0)
+    for n in (na, nb, nc):
+        n.start()
+    try:
+        # B and C both peer with A only
+        for svc in (nb, nc):
+            svc.connect("127.0.0.1", na.port)
+        topic = na.topic_block
+        b_id, c_id = f"127.0.0.1:{nb.port}", f"127.0.0.1:{nc.port}"
+        for pid in (b_id, c_id):
+            _wait(
+                lambda p=pid: topic in na.gossip.behaviour.peer_topics.get(p, ()),
+                what="subscriptions at A",
+            )
+        na.gossip.heartbeat()
+        assert {b_id, c_id} <= na.gossip.mesh_peers(topic)
+        # squeeze A's mesh so C gets pruned WITH peer exchange; raise B's
+        # score so it is retained and appears in the PX records
+        for _ in range(20):
+            na.gossip.behaviour.score.first_delivery(b_id, topic)
+        # C only accepts PX from peers above accept_px_threshold (10):
+        # make A a proven message source from C's point of view
+        a_id_at_c = f"127.0.0.1:{na.port}"
+        for _ in range(20):
+            nc.gossip.behaviour.score.first_delivery(a_id_at_c, topic)
+        cfg = na.gossip.behaviour.config
+        cfg.d, cfg.d_lo, cfg.d_hi, cfg.d_score = 1, 0, 1, 1
+        na.gossip.heartbeat()
+        assert na.gossip.mesh_peers(topic) == {b_id}
+        # C received PRUNE(px=[B]) and dials B on its next heartbeat
+        _wait(
+            lambda: (topic, f"127.0.0.1:{na.port}") in nc.gossip.behaviour.backoff,
+            what="PRUNE landing at C",
+        )
+        nc.gossip.heartbeat()
+        _wait(
+            lambda: any(p.port == nb.port for p in nc.peers.peers()),
+            what="PX dial from C to B",
+        )
+    finally:
+        for n in (na, nb, nc):
+            n.stop()

@@ -182,3 +182,48 @@ def test_da_checker_flow(kzg):
     checker.put_block(root3, block, slot=3)
     checker.prune_before(10)
     assert not checker.has_pending(root3)
+
+
+# ---------------------------------------------------------------------------
+# device fallback observability + strict mode (LIGHTHOUSE_TPU_STRICT_DEVICE)
+# ---------------------------------------------------------------------------
+
+
+class _ExplodingDev:
+    def boom(self):
+        raise RuntimeError("simulated remote-compile failure")
+
+
+def test_device_fallback_is_counted_and_disables_device(kzg, monkeypatch):
+    from lighthouse_tpu.metrics import REGISTRY
+
+    monkeypatch.delenv("LIGHTHOUSE_TPU_STRICT_DEVICE", raising=False)
+    counter = REGISTRY.counter("kzg_device_fallback_total")
+    before = counter.value(stage="call")
+    kzg._dev = _ExplodingDev()
+    kzg._dev_warned = False
+    assert kzg._device_call(lambda d: d.boom()) is None  # host fallback
+    assert counter.value(stage="call") == before + 1
+    assert kzg._dev is None  # device path disabled after the failure
+    assert kzg.verify_blob_kzg_proof_device_stats() == {"device": False}
+
+
+def test_device_fallback_strict_mode_raises(kzg, monkeypatch):
+    from lighthouse_tpu.metrics import REGISTRY
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_STRICT_DEVICE", "1")
+    counter = REGISTRY.counter("kzg_device_fallback_total")
+    before = counter.value(stage="call")
+    kzg._dev = _ExplodingDev()
+    with pytest.raises(KzgError, match="STRICT_DEVICE"):
+        kzg._device_call(lambda d: d.boom())
+    assert counter.value(stage="call") == before + 1  # still observable
+    assert kzg._dev is None
+
+
+def test_device_call_noop_when_no_device(kzg, monkeypatch):
+    monkeypatch.setenv("LIGHTHOUSE_TPU_STRICT_DEVICE", "1")
+    kzg._dev = None
+    # no device configured at all is NOT a fallback event: strict mode
+    # only guards a device path that was supposed to be live
+    assert kzg._device_call(lambda d: d.boom()) is None

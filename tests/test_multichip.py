@@ -14,6 +14,10 @@ import pytest
 from lighthouse_tpu.ops.merkle_sharded import build_sharded_merkle
 from lighthouse_tpu.ops.sha256 import bytes_to_words, words_to_bytes
 
+# every test in this file is tier-2: 8-device mesh kernels: slow XLA-CPU compiles.
+# tests/conftest.py enforces this marker at collection time.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def eight_devices():
