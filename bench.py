@@ -43,12 +43,21 @@ from lighthouse_tpu.utils.compile_cache import enable_compile_cache  # noqa: E40
 enable_compile_cache()
 
 
-def _trials(fn, n=3):
+def _partial(**kw):
+    """Stream a progress line so a metric killed by the budget still leaves
+    its completed per-trial/per-chunk timings behind: the parent collects
+    `PARTIAL {...}` lines from the dead subprocess's stdout into the
+    combined JSON's errors[metric]["partial"]."""
+    print("PARTIAL " + json.dumps(kw), flush=True)
+
+
+def _trials(fn, n=3, label="trial"):
     out = []
-    for _ in range(n):
+    for i in range(n):
         t0 = time.perf_counter()
         fn()
         out.append(time.perf_counter() - t0)
+        _partial(**{label: i + 1, "of": n, "s": round(out[-1], 4)})
     return {
         "median_s": statistics.median(out),
         "min_s": min(out),
@@ -173,10 +182,14 @@ def bench_bls(jax):
 
     def dev_run():
         if chunk:
+            t0 = time.perf_counter()
             for i in range(0, n_sets, chunk):
                 assert verify_signature_sets_device_full(
                     sets[i:i + chunk], random.Random(5 + i)
                 )
+                _partial(chunk_done=i // chunk + 1,
+                         of=(n_sets + chunk - 1) // chunk,
+                         elapsed_s=round(time.perf_counter() - t0, 2))
         else:
             assert verify_signature_sets_device_full(sets, random.Random(5))
 
@@ -202,6 +215,50 @@ def bench_bls(jax):
         "baseline_control": "host-python RLC (no blst in image); see BENCH_NOTES.md",
         "config": {"sets": n_sets, "committee": committee, "chunk": chunk},
         "spread": t,
+    }
+
+
+def bench_pairing(jax):
+    """Host microbench for the optimized pairing path: one `pairing_check`
+    of 2 pairs — the exact shape of a single signature verification and the
+    `vs_baseline` control every device number is scored against. The old
+    (reference) path is timed once alongside for the continuity record in
+    BENCH_NOTES.md."""
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls import cache_stats
+    from lighthouse_tpu.crypto.bls12_381 import (
+        FQ, G1_GEN, hash_to_g2, pairing_check, pt_neg,
+    )
+    from lighthouse_tpu.crypto.bls12_381 import pairing_reference
+
+    bls.set_backend("host")
+    sk = bls.interop_secret_key(0)
+    pk_pt = sk.public_key().point()
+    msg = hashlib.sha256(b"pairing microbench").digest()
+    h = hash_to_g2(msg)
+    sig_pt = sk.sign(msg).point()
+    pairs = [(pk_pt, h), (pt_neg(FQ, G1_GEN), sig_pt)]
+
+    def run():
+        assert pairing_check(pairs)
+
+    run()  # warm (builds the fixed-base/window tables)
+    t = _trials(run, n=5)
+    # ≥3-trial median for the control too — a single-trial control made
+    # vs_baseline pure noise (BENCH_NOTES "Variance")
+    tr = _trials(lambda: pairing_reference.pairing_check(pairs), n=3,
+                 label="ref_trial")
+
+    return {
+        "metric": "pairing_check_ms",
+        "value": round(t["median_s"] * 1000, 2),
+        "unit": "ms/check (2 pairs, host)",
+        "vs_baseline": round(tr["median_s"] / t["median_s"], 2),
+        "baseline_control": "pairing_reference (pre-optimization host path)",
+        "reference_ms": round(tr["median_s"] * 1000, 2),
+        "spread": t,
+        "control_spread": tr,
+        "cache": cache_stats(),
     }
 
 
@@ -295,6 +352,10 @@ def bench_block_import(jax):
 
     _STAGES = (
         "signature_batch_verify",
+        "signature_set_assembly",
+        "bls_rlc_accumulate",
+        "bls_hash_to_g2",
+        "bls_pairing",
         "state_transition",
         "fork_choice_on_block",
     )
@@ -325,6 +386,8 @@ def bench_block_import(jax):
                 "mean_ms": round(d_sum / d_count * 1000, 2),
                 "samples": d_count,
             }
+    from lighthouse_tpu.crypto.bls import cache_stats
+
     return {
         "metric": "block_import_ms",
         "value": round(statistics.median(times) * 1000, 2),
@@ -336,6 +399,7 @@ def bench_block_import(jax):
             "backend": backend,
         },
         "stages": stages,
+        "cache": cache_stats(),
     }
 
 
@@ -480,12 +544,30 @@ def bench_epoch_transition(jax):
 
 _METRICS = {
     "merkle": bench_merkle,
+    "pairing": bench_pairing,
     "block_import": bench_block_import,
     "epoch_transition": bench_epoch_transition,
     "state_root": bench_state_root,
     "kzg": bench_kzg,
     "bls": bench_bls,
 }
+
+
+def _collect_partials(stdout) -> list:
+    """Pull `PARTIAL {...}` progress lines out of a (possibly dead)
+    subprocess's stdout."""
+    if not stdout:
+        return []
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode(errors="replace")
+    out = []
+    for line in stdout.splitlines():
+        if line.startswith("PARTIAL "):
+            try:
+                out.append(json.loads(line[len("PARTIAL "):]))
+            except ValueError:
+                pass
+    return out
 
 
 def _run_one(name: str) -> int:
@@ -525,12 +607,20 @@ def main():
                 timeout=min(cap, remaining),
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
-        except subprocess.TimeoutExpired:
-            errors[name] = f"timed out (> {min(cap, remaining):.0f}s)"
+        except subprocess.TimeoutExpired as e:
+            # keep whatever per-trial/per-chunk timings completed: a timed-out
+            # metric still yields data instead of a bare error string
+            partial = _collect_partials(e.stdout)
+            msg = f"timed out (> {min(cap, remaining):.0f}s)"
+            errors[name] = {"error": msg, "partial": partial} if partial else msg
             return None
         if proc.returncode != 0:
             tail = (proc.stderr or "").strip().splitlines()[-3:]
-            errors[name] = f"exit {proc.returncode}: {' | '.join(tail)}"
+            msg = f"exit {proc.returncode}: {' | '.join(tail)}"
+            # a crashed metric (OOM kill, assert) salvages its completed
+            # trial/chunk timings exactly like a timed-out one
+            partial = _collect_partials(proc.stdout)
+            errors[name] = {"error": msg, "partial": partial} if partial else msg
             return None
         try:
             # last stdout line is the metric JSON (warnings may precede)
@@ -549,6 +639,7 @@ def main():
 
     secondary_caps = {
         "merkle": 180,
+        "pairing": 60,  # host microbench, no compiles
         "block_import": 90,
         "epoch_transition": 120,
         "state_root": 240,  # 1M-validator build + fresh tree shapes

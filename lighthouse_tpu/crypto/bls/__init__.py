@@ -19,15 +19,22 @@ always 32-byte signing roots (consensus/types/src/signing_data.rs:22-35).
 
 from __future__ import annotations
 
+import os
 import secrets
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 
+from ...metrics import REGISTRY, inc_counter
+from ...utils.tracing import span
 from ..bls12_381 import (
+    DST_G2_POP,
     FQ,
     FQ2,
     G1_GEN,
     R,
     g1_from_bytes,
+    g1_gen_mul,
     g1_in_subgroup,
     g1_to_bytes,
     g2_from_bytes,
@@ -54,6 +61,151 @@ INFINITY_SIGNATURE = bytes([0xC0]) + bytes(95)
 
 class BlsError(ValueError):
     pass
+
+
+# ---------------------------------------------------------------------------
+# Verification caches
+# ---------------------------------------------------------------------------
+# Block import re-sees the same material constantly: validator pubkeys recur
+# every block (the reference keeps them decompressed in
+# beacon_chain/src/validator_pubkey_cache.rs), the same attestation message
+# recurs across sets/retries, and a signature revalidated on a retry repeats
+# its subgroup check. Two bounded LRUs cover all of it:
+#   * bytes → point decompression caches for PublicKey/Signature, each entry
+#     carrying a "validated" flag so subgroup checks run once per encoding;
+#   * an LRU for hash_to_g2(msg, dst).
+# Hit/miss counters are exported through the metrics registry
+# (bls_cache_{hits,misses}_total{cache=...}); tests/conftest.py asserts the
+# export exists.
+
+
+class LruCache:
+    """Minimal locked bounded LRU — the one get/insert/evict implementation
+    behind every verification cache (and signature_sets' pubkey object
+    cache), so the locking discipline lives in exactly one place."""
+
+    __slots__ = ("maxsize", "_entries", "_lock")
+
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._entries: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            value = self._entries.get(key)
+            if value is not None:
+                self._entries.move_to_end(key)
+            return value
+
+    def setdefault(self, key, value):
+        """Insert-if-absent; returns the resident value either way."""
+        with self._lock:
+            current = self._entries.get(key)
+            if current is not None:
+                self._entries.move_to_end(key)
+                return current
+            self._entries[key] = value
+            if len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+            return value
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+
+class _DecompressionCache:
+    """Bounded bytes→point LRU with a subgroup-validated flag."""
+
+    __slots__ = ("name", "_lru")
+
+    def __init__(self, name: str, maxsize: int):
+        self.name = name
+        self._lru = LruCache(maxsize)
+
+    @property
+    def maxsize(self) -> int:
+        return self._lru.maxsize
+
+    def point(self, data: bytes, decompress):
+        entry = self._lru.get(data)
+        if entry is not None:
+            inc_counter("bls_cache_hits_total", cache=self.name)
+            return entry[0]
+        inc_counter("bls_cache_misses_total", cache=self.name)
+        point = decompress(data)  # may raise ValueError; nothing cached
+        return self._lru.setdefault(data, [point, False])[0]
+
+    def validate(self, data: bytes, point, checker) -> bool:
+        """True iff `point` passes `checker`, remembering success so the
+        check runs once per encoding."""
+        entry = self._lru.get(data)
+        if entry is not None and entry[1]:
+            inc_counter("bls_cache_hits_total", cache=self.name + "_validated")
+            return True
+        inc_counter("bls_cache_misses_total", cache=self.name + "_validated")
+        ok = checker(point)
+        if ok:
+            # entry[1] is a plain flag flip: benign if two threads race it
+            self._lru.setdefault(data, [point, True])[1] = True
+        return ok
+
+    def clear(self):
+        self._lru.clear()
+
+
+# Pubkey capacity follows the reference's ValidatorPubkeyCache, which keeps
+# EVERY validator's decompressed key resident (validator_pubkey_cache.rs:17):
+# default 2^20 covers a mainnet-scale registry, and the registry sweeps once
+# per epoch so a smaller bound would thrash decompression + subgroup checks.
+# Signatures are transient (per-block/gossip), so a small LRU suffices.
+_PK_CACHE = _DecompressionCache(
+    "pubkey", int(os.environ.get("LIGHTHOUSE_TPU_BLS_PK_CACHE", str(1 << 20)))
+)
+_SIG_CACHE = _DecompressionCache(
+    "signature", int(os.environ.get("LIGHTHOUSE_TPU_BLS_SIG_CACHE", "8192"))
+)
+
+_H2G_CACHE = LruCache(int(os.environ.get("LIGHTHOUSE_TPU_BLS_H2G_CACHE", "2048")))
+
+
+def hash_to_g2_cached(message: bytes, dst: bytes = DST_G2_POP):
+    """`hash_to_g2` behind a bounded LRU — the same signing root recurs
+    across signature sets, retries and the signing path."""
+    key = (message, dst)
+    point = _H2G_CACHE.get(key)
+    if point is not None:
+        inc_counter("bls_cache_hits_total", cache="hash_to_g2")
+        return point
+    inc_counter("bls_cache_misses_total", cache="hash_to_g2")
+    return _H2G_CACHE.setdefault(key, hash_to_g2(message, dst))
+
+
+# Register every counter label eagerly so the exposition (and the bench's
+# cache report) shows zeros instead of omitting the series.
+for _c in (
+    "pubkey", "pubkey_validated", "signature", "signature_validated",
+    "hash_to_g2",
+):
+    REGISTRY.counter("bls_cache_hits_total").inc(0.0, cache=_c)
+    REGISTRY.counter("bls_cache_misses_total").inc(0.0, cache=_c)
+del _c
+
+
+def cache_stats() -> dict:
+    """{cache: {"hits": n, "misses": n}} snapshot for bench/metrics report."""
+    hits = REGISTRY.counter("bls_cache_hits_total").values()
+    misses = REGISTRY.counter("bls_cache_misses_total").values()
+    out = {}
+    for key in set(hits) | set(misses):
+        name = dict(key).get("cache")
+        if name:
+            out[name] = {
+                "hits": hits.get(key, 0.0),
+                "misses": misses.get(key, 0.0),
+            }
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -92,19 +244,20 @@ class PublicKey:
         if self._point is None:
             if self._bytes == INFINITY_PUBLIC_KEY:
                 raise BlsError("pubkey is the point at infinity")
-            self._point = g1_from_bytes(self._bytes)
+            self._point = _PK_CACHE.point(self._bytes, g1_from_bytes)
         return self._point
 
     def validate(self) -> bool:
-        """KeyValidate: decompresses, rejects infinity, checks subgroup."""
+        """KeyValidate: decompresses, rejects infinity, checks subgroup.
+        The subgroup check is deduplicated through the decompression cache's
+        validated flag — one check per encoding, not per call."""
         if _backend.fake:
             return True
         try:
-            return g1_in_subgroup(self.point())
-        except BlsError:
+            pt = self.point()
+        except (BlsError, ValueError):
             return False
-        except ValueError:
-            return False
+        return _PK_CACHE.validate(self._bytes, pt, g1_in_subgroup)
 
     def __eq__(self, other):
         return isinstance(other, PublicKey) and self._bytes == other._bytes
@@ -145,8 +298,17 @@ class Signature:
 
     def point(self):
         if self._point is None:
-            self._point = g2_from_bytes(self._bytes)
+            self._point = _SIG_CACHE.point(self._bytes, g2_from_bytes)
         return self._point
+
+    def subgroup_check(self) -> bool:
+        """G2 subgroup membership, deduplicated via the decompression
+        cache's validated flag (a retried signature re-checks for free)."""
+        try:
+            pt = self.point()
+        except (BlsError, ValueError):
+            return False
+        return _SIG_CACHE.validate(self._bytes, pt, g2_in_subgroup)
 
     def verify(self, pubkey: PublicKey, message: bytes) -> bool:
         return _backend.verify(self, pubkey, message)
@@ -191,7 +353,8 @@ class SecretKey:
     def public_key(self) -> PublicKey:
         if _backend.fake:
             return PublicKey(_fake_pubkey_bytes(self._scalar))
-        return PublicKey.from_point(pt_mul(FQ, G1_GEN, self._scalar))
+        # fixed-base window table: ≤64 additions instead of a 256-bit ladder
+        return PublicKey.from_point(g1_gen_mul(self._scalar))
 
     def sign(self, message: bytes) -> Signature:
         return _backend.sign(self, message)
@@ -281,7 +444,7 @@ class _HostBackend:
     fake = False
 
     def sign(self, sk: SecretKey, message: bytes) -> Signature:
-        h = hash_to_g2(message)
+        h = hash_to_g2_cached(message)
         return Signature.from_point(pt_mul(FQ2, h, sk.scalar))
 
     def verify(self, sig: Signature, pubkey: PublicKey, message: bytes) -> bool:
@@ -292,11 +455,13 @@ class _HostBackend:
             pk_pt = pubkey.point()
         except (BlsError, ValueError):
             return False
-        if not g2_in_subgroup(sig_pt) or not g1_in_subgroup(pk_pt):
+        # subgroup checks deduplicated through the validated flags — a
+        # pubkey that already passed PublicKey.validate() is not re-checked
+        if not sig.subgroup_check() or not pubkey.validate():
             return False
         if is_inf(FQ, pk_pt):
             return False
-        h = hash_to_g2(message)
+        h = hash_to_g2_cached(message)
         # e(pk, H(m)) · e(-g1, sig) == 1
         return pairing_check([(pk_pt, h), (pt_neg(FQ, G1_GEN), sig_pt)])
 
@@ -305,41 +470,46 @@ class _HostBackend:
         (crypto/bls/src/impls/blst.rs:35-117):
         e(-g1, Σ rᵢ·sigᵢ) · ∏_m e(Σ_{i: mᵢ=m} rᵢ·aggpkᵢ, H(m)) == 1.
         Same-message sets share one pairing (attestation batches are mostly
-        one message per committee)."""
+        one message per committee). Each stage carries its own trace span so
+        bench_block_import prices decompression/RLC, hashing and the pairing
+        separately."""
         sets = list(sets)
         if not sets:
             return False
         rand = rng if rng is not None else secrets.SystemRandom()
         agg_sig = inf(FQ2)
         by_message: dict[bytes, object] = {}
-        for s in sets:
-            try:
-                if s.signature.is_infinity():
+        with span("bls_rlc_accumulate", sets=len(sets)):
+            for s in sets:
+                try:
+                    if s.signature.is_infinity():
+                        return False
+                    sig_pt = s.signature.point()
+                    if not s.signature.subgroup_check():
+                        return False
+                    pk_pts = [pk.point() for pk in s.pubkeys]
+                except (BlsError, ValueError):
                     return False
-                sig_pt = s.signature.point()
-                if not g2_in_subgroup(sig_pt):
+                if not pk_pts:
                     return False
-                pk_pts = [pk.point() for pk in s.pubkeys]
-            except (BlsError, ValueError):
-                return False
-            if not pk_pts:
-                return False
-            r = 0
-            while r == 0:
-                r = rand.getrandbits(RAND_BITS)
-            agg_sig = pt_add(FQ2, agg_sig, pt_mul(FQ2, sig_pt, r))
-            agg_pk = inf(FQ)
-            for p in pk_pts:
-                agg_pk = pt_add(FQ, agg_pk, p)
-            scaled = pt_mul(FQ, agg_pk, r)
-            prev = by_message.get(s.message)
-            by_message[s.message] = (
-                scaled if prev is None else pt_add(FQ, prev, scaled)
-            )
+                r = 0
+                while r == 0:
+                    r = rand.getrandbits(RAND_BITS)
+                agg_sig = pt_add(FQ2, agg_sig, pt_mul(FQ2, sig_pt, r))
+                agg_pk = inf(FQ)
+                for p in pk_pts:
+                    agg_pk = pt_add(FQ, agg_pk, p)
+                scaled = pt_mul(FQ, agg_pk, r)
+                prev = by_message.get(s.message)
+                by_message[s.message] = (
+                    scaled if prev is None else pt_add(FQ, prev, scaled)
+                )
         pairs = [(pt_neg(FQ, G1_GEN), agg_sig)]
-        for message, pk_pt in by_message.items():
-            pairs.append((pk_pt, hash_to_g2(message)))
-        return pairing_check(pairs)
+        with span("bls_hash_to_g2", messages=len(by_message)):
+            for message, pk_pt in by_message.items():
+                pairs.append((pk_pt, hash_to_g2_cached(message)))
+        with span("bls_pairing", pairs=len(pairs)):
+            return pairing_check(pairs)
 
 
 def _fake_pubkey_bytes(scalar: int) -> bytes:

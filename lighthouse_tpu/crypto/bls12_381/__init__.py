@@ -17,6 +17,7 @@ from .curve import (
     H1,
     H2,
     g1_from_bytes,
+    g1_gen_mul,
     g1_in_subgroup,
     g1_is_on_curve,
     g1_to_bytes,
@@ -24,6 +25,7 @@ from .curve import (
     g2_from_bytes,
     g2_in_subgroup,
     g2_is_on_curve,
+    g2_psi,
     g2_to_bytes,
     inf,
     is_inf,
@@ -31,6 +33,7 @@ from .curve import (
     pt_double,
     pt_eq,
     pt_mul,
+    pt_mul_binary,
     pt_neg,
     to_affine,
 )
@@ -40,9 +43,9 @@ from .pairing import multi_pairing, pairing, pairing_check
 
 __all__ = [
     "P", "R", "X", "B1", "B2", "FQ", "FQ2", "G1_GEN", "G2_GEN", "H1", "H2",
-    "g1_from_bytes", "g1_in_subgroup", "g1_is_on_curve", "g1_to_bytes",
-    "g2_clear_cofactor", "g2_from_bytes", "g2_in_subgroup", "g2_is_on_curve",
-    "g2_to_bytes", "inf", "is_inf", "pt_add", "pt_double", "pt_eq", "pt_mul",
-    "pt_neg", "to_affine", "DST_G2_POP", "hash_to_g2", "multi_pairing",
-    "pairing", "pairing_check",
+    "g1_from_bytes", "g1_gen_mul", "g1_in_subgroup", "g1_is_on_curve",
+    "g1_to_bytes", "g2_clear_cofactor", "g2_from_bytes", "g2_in_subgroup",
+    "g2_is_on_curve", "g2_psi", "g2_to_bytes", "inf", "is_inf", "pt_add",
+    "pt_double", "pt_eq", "pt_mul", "pt_mul_binary", "pt_neg", "to_affine",
+    "DST_G2_POP", "hash_to_g2", "multi_pairing", "pairing", "pairing_check",
 ]

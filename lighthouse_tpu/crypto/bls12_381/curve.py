@@ -159,10 +159,11 @@ def pt_add(k: FieldOps, p1, p2):
     return (x3, y3, z3)
 
 
-def pt_mul(k: FieldOps, pt, n: int):
-    """Scalar multiplication (binary double-and-add)."""
+def pt_mul_binary(k: FieldOps, pt, n: int):
+    """Scalar multiplication (binary double-and-add) — the reference ladder,
+    kept as the differential oracle for the wNAF path."""
     if n < 0:
-        return pt_mul(k, pt_neg(k, pt), -n)
+        return pt_mul_binary(k, pt_neg(k, pt), -n)
     result = inf(k)
     addend = pt
     while n:
@@ -170,6 +171,46 @@ def pt_mul(k: FieldOps, pt, n: int):
             result = pt_add(k, result, addend)
         addend = pt_double(k, addend)
         n >>= 1
+    return result
+
+
+def _wnaf_digits(n: int, w: int) -> list:
+    """Width-w non-adjacent form, LSB first: digits in ±{1,3,…,2^(w−1)−1}∪{0},
+    no two adjacent nonzeros — bits/(w+1) additions on average vs bits/2."""
+    digits = []
+    while n:
+        if n & 1:
+            d = n & ((1 << w) - 1)
+            if d >= 1 << (w - 1):
+                d -= 1 << w
+            n -= d
+        else:
+            d = 0
+        digits.append(d)
+        n >>= 1
+    return digits
+
+
+def pt_mul(k: FieldOps, pt, n: int):
+    """Scalar multiplication via wNAF with a precomputed odd-multiples table
+    (window 4 below ~130 bits, 5 above)."""
+    if n < 0:
+        return pt_mul(k, pt_neg(k, pt), -n)
+    if n == 0 or k.is_zero(pt[2]):
+        return inf(k)
+    w = 4 if n.bit_length() < 130 else 5
+    digits = _wnaf_digits(n, w)
+    two_pt = pt_double(k, pt)
+    tbl = [pt]  # tbl[i] = (2i+1)·pt
+    for _ in range((1 << (w - 2)) - 1):
+        tbl.append(pt_add(k, tbl[-1], two_pt))
+    result = inf(k)
+    for d in reversed(digits):
+        result = pt_double(k, result)
+        if d > 0:
+            result = pt_add(k, result, tbl[(d - 1) >> 1])
+        elif d < 0:
+            result = pt_add(k, result, pt_neg(k, tbl[(-d - 1) >> 1]))
     return result
 
 
@@ -229,8 +270,69 @@ assert H1 == 0x396C8C005555E1568C00AAAB0000AAAB
 H2_EFF = 3 * (X * X - 1) * H2
 
 # ---------------------------------------------------------------------------
+# Fixed-base scalar multiplication for the G1 generator
+# ---------------------------------------------------------------------------
+# Every `public_key()` is a G1_GEN multiple; a one-time 4-bit window table
+# (tbl[i][d-1] = d·2^(4i)·G, 64 chunks × 15 digits) turns the 256-bit ladder
+# into ≤64 additions with no doublings. Built lazily on first use.
+
+_GEN_TBL: list | None = None
+
+
+def _build_gen_table() -> list:
+    tbl = []
+    base = G1_GEN
+    for _ in range(64):
+        row = [base]
+        for _ in range(14):
+            row.append(pt_add(FQ, row[-1], base))
+        tbl.append(row)
+        for _ in range(4):
+            base = pt_double(FQ, base)
+    return tbl
+
+
+def g1_gen_mul(n: int):
+    """[n]·G1_GEN via the fixed-base window table."""
+    global _GEN_TBL
+    if _GEN_TBL is None:
+        _GEN_TBL = _build_gen_table()
+    n %= R
+    acc = inf(FQ)
+    i = 0
+    while n:
+        d = n & 15
+        if d:
+            acc = pt_add(FQ, acc, _GEN_TBL[i][d - 1])
+        n >>= 4
+        i += 1
+    return acc
+
+
+# ---------------------------------------------------------------------------
 # Subgroup / membership checks
 # ---------------------------------------------------------------------------
+
+# ψ = untwist ∘ Frobenius ∘ twist, on twisted coordinates:
+# ψ(x, y) = (cx·x̄, cy·ȳ) with cx = ξ^(−(p−1)/3), cy = ξ^(−(p−1)/2).
+# On G2 it acts as multiplication by x (p ≡ x mod r) — the basis of both the
+# fast membership test and Budroni–Pintore cofactor clearing; the same
+# criterion the device kernels use (ops/bls381_pairing.py).
+_PSI_CX = F.f2_pow(F.f2_inv(F.XI), (P - 1) // 3)
+_PSI_CY = F.f2_pow(F.f2_inv(F.XI), (P - 1) // 2)
+
+assert P % R == X % R  # ψ acts as [x] on G2
+
+
+def g2_psi(pt):
+    """ψ on Jacobian twisted coordinates (conjugate-linear, so Z̄ carries the
+    coordinate weights through)."""
+    x, y, z = pt
+    return (
+        F.f2_mul(F.f2_conj(x), _PSI_CX),
+        F.f2_mul(F.f2_conj(y), _PSI_CY),
+        F.f2_conj(z),
+    )
 
 
 def g1_is_on_curve(pt) -> bool:
@@ -246,7 +348,15 @@ def g1_in_subgroup(pt) -> bool:
 
 
 def g2_in_subgroup(pt) -> bool:
-    return g2_is_on_curve(pt) and is_inf(FQ2, pt_mul(FQ2, pt, R))
+    """ψ(Q) == [x]Q membership test: a 64-bit ladder instead of the 255-bit
+    order multiplication (differentially tested against it)."""
+    if not g2_is_on_curve(pt):
+        return False
+    if is_inf(FQ2, pt):
+        return True
+    # x < 0: ψ(Q) − [x]Q = ψ(Q) + [|x|]Q
+    s = pt_add(FQ2, g2_psi(pt), pt_mul(FQ2, pt, -X))
+    return is_inf(FQ2, s)
 
 
 # ---------------------------------------------------------------------------
@@ -349,11 +459,24 @@ def g2_from_bytes(data: bytes):
 def g2_clear_cofactor(pt):
     """Map a point on E2 into the r-order subgroup G2.
 
-    Multiplies by the RFC 9380 effective cofactor h_eff = 3(z²−1)·h2, which
-    is what BLS12381G2_XMD:SHA-256_SSWU_RO_ (and hence blst / the reference's
-    crypto/bls/src/impls/blst.rs hashing) uses — NOT the plain cofactor h2.
+    Computes [h_eff]Q for the RFC 9380 effective cofactor h_eff = 3(z²−1)·h2
+    (what BLS12381G2_XMD:SHA-256_SSWU_RO_ and hence blst use — NOT the plain
+    cofactor h2), via the Budroni–Pintore endomorphism form
+
+        [h_eff]Q = [x²−x−1]Q + [x−1]ψ(Q) + ψ²(2Q)
+
+    — two |x|-ladders and three ψ instead of a 636-bit multiplication. The
+    identity is differentially tested against pt_mul(·, H2_EFF), and the
+    device kernel (ops/bls381_pairing.g2_clear_cofactor_device) uses the
+    same form.
     """
-    return pt_mul(FQ2, pt, H2_EFF)
+    a = pt_neg(FQ2, pt_mul(FQ2, pt, -X))  # [x]Q
+    neg_q = pt_neg(FQ2, pt)
+    c1 = pt_add(FQ2, a, neg_q)  # [x−1]Q
+    c2 = pt_neg(FQ2, pt_mul(FQ2, c1, -X))  # [x²−x]Q
+    c3 = pt_add(FQ2, c2, neg_q)  # [x²−x−1]Q
+    out = pt_add(FQ2, c3, g2_psi(c1))
+    return pt_add(FQ2, out, g2_psi(g2_psi(pt_double(FQ2, pt))))
 
 
 def g1_clear_cofactor(pt):

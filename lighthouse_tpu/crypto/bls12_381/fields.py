@@ -75,6 +75,13 @@ def f2_mul_scalar(a, k: int):
     return (a[0] * k % P, a[1] * k % P)
 
 
+_INV2 = (P + 1) // 2  # 1/2 mod P
+
+
+def f2_half(a):
+    return (a[0] * _INV2 % P, a[1] * _INV2 % P)
+
+
 def f2_mul_xi(a):
     """Multiply by ξ = 1 + u:  (c0 - c1) + (c0 + c1)u."""
     a0, a1 = a
@@ -286,6 +293,92 @@ def f12_pow(a, e: int):
 
 def f12_is_one(a) -> bool:
     return a == F12_ONE
+
+
+# ---------------------------------------------------------------------------
+# Sparse Fq12 multiplication (Miller-loop line folding)
+# ---------------------------------------------------------------------------
+# With the M-type twist and the w²=v tower, a Miller-loop line evaluated at an
+# embedded G1 point is sparse in the basis {1, v, v², w, vw, v²w}: only the
+# coefficients at 1, vw and v²w are nonzero (see pairing.py for the
+# derivation). Folding such an element into the accumulator needs 16 Fq2
+# multiplications and ~1/3 the additions of the dense 18-mul f12_mul.
+
+
+def f12_mul_by_045(f, c0, c4, c5):
+    """f · (c0 + c4·vw + c5·v²w) for c0, c4, c5 ∈ Fq2."""
+    (a0, a1, a2), (b0, b1, b2) = f
+    # (A + Bw)(c0 + L1·w) = (A·c0 + v·(B·L1)) + (A·L1 + B·c0)·w,
+    # with L1 = c4·v + c5·v² sparse in Fq6 (5-mul Karatsuba each product).
+    ta = (f2_mul(a0, c0), f2_mul(a1, c0), f2_mul(a2, c0))
+    tb = (f2_mul(b0, c0), f2_mul(b1, c0), f2_mul(b2, c0))
+    c45 = f2_add(c4, c5)
+
+    def _sparse_l1(x0, x1, x2):
+        m1 = f2_mul(x1, c4)
+        m2 = f2_mul(x2, c5)
+        mx = f2_mul(f2_add(x1, x2), c45)
+        return (
+            f2_mul_xi(f2_sub(f2_sub(mx, m1), m2)),
+            f2_add(f2_mul(x0, c4), f2_mul_xi(m2)),
+            f2_add(f2_mul(x0, c5), m1),
+        )
+
+    al1 = _sparse_l1(a0, a1, a2)
+    bl1 = _sparse_l1(b0, b1, b2)
+    return (f6_add(ta, f6_mul_by_v(bl1)), f6_add(al1, tb))
+
+
+# ---------------------------------------------------------------------------
+# Cyclotomic-subgroup arithmetic (final-exponentiation hard part)
+# ---------------------------------------------------------------------------
+# After the easy part f^((p⁶−1)(p²+1)), the result lies in the cyclotomic
+# subgroup G_{Φ12}(q) = {f : f^(p⁴−p²+1) = 1}, where Granger–Scott
+# compressed squaring applies: viewing Fq12 as Fq4-towered, each of the three
+# Fq4 "columns" squares with 3 Fq2 squarings instead of a full f12_sqr.
+# Within that subgroup, conjugation is inversion (p⁶ ≡ −1 mod p⁴−p²+1).
+
+
+def _f4_sqr(a, b):
+    """(a + b·s)² in Fq4 = Fq2[s]/(s² − ξ): returns (a² + ξb², 2ab)."""
+    t0 = f2_sqr(a)
+    t1 = f2_sqr(b)
+    return (
+        f2_add(f2_mul_xi(t1), t0),
+        f2_sub(f2_sub(f2_sqr(f2_add(a, b)), t0), t1),
+    )
+
+
+def f12_cyclotomic_sqr(f):
+    """f² for f in the cyclotomic subgroup (Granger–Scott)."""
+    (z0, z4, z3), (z2, z1, z5) = f
+    t0, t1 = _f4_sqr(z0, z1)
+    z0 = f2_add(f2_add(f2_sub(t0, z0), f2_sub(t0, z0)), t0)  # 3t0 − 2z0
+    z1 = f2_add(f2_add(f2_add(t1, z1), f2_add(t1, z1)), t1)  # 3t1 + 2z1
+    t0b, t1b = _f4_sqr(z2, z3)
+    t2, t3 = _f4_sqr(z4, z5)
+    z4 = f2_add(f2_add(f2_sub(t0b, z4), f2_sub(t0b, z4)), t0b)
+    z5 = f2_add(f2_add(f2_add(t1b, z5), f2_add(t1b, z5)), t1b)
+    t0c = f2_mul_xi(t3)
+    z2 = f2_add(f2_add(f2_add(t0c, z2), f2_add(t0c, z2)), t0c)
+    z3 = f2_add(f2_add(f2_sub(t2, z3), f2_sub(t2, z3)), t2)
+    return ((z0, z4, z3), (z2, z1, z5))
+
+
+def f12_cyclotomic_pow(f, e: int):
+    """f^e for f in the cyclotomic subgroup, e > 0: square-and-multiply with
+    cyclotomic squarings. For e < 0 use f12_conj of the |e| power (conjugation
+    is inversion in the subgroup)."""
+    if e < 0:
+        return f12_conj(f12_cyclotomic_pow(f, -e))
+    if e == 0:
+        return F12_ONE
+    res = f
+    for bit in bin(e)[3:]:
+        res = f12_cyclotomic_sqr(res)
+        if bit == "1":
+            res = f12_mul(res, f)
+    return res
 
 
 # ---------------------------------------------------------------------------

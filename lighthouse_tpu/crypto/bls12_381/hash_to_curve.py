@@ -127,8 +127,12 @@ def map_to_curve_sswu(u):
     else:
         x1 = F.f2_mul(_MINUS_B_OVER_A, F.f2_add(F.F2_ONE, F.f2_inv(tv)))
     gx1 = F.f2_add(F.f2_add(F.f2_mul(F.f2_sqr(x1), x1), F.f2_mul(_A, x1)), _B)
-    if F.f2_legendre(gx1) >= 0:
-        x, y = x1, F.f2_sqrt(gx1)
+    # Try √gx1 directly — f2_sqrt returns None for non-squares, so the
+    # separate Legendre pre-check (an extra Fq exponentiation per map) is
+    # redundant; SSWU guarantees gx2 is square whenever gx1 is not.
+    y = F.f2_sqrt(gx1)
+    if y is not None:
+        x = x1
     else:
         x2 = F.f2_mul(z_u2, x1)
         gx2 = F.f2_add(F.f2_add(F.f2_mul(F.f2_sqr(x2), x2), F.f2_mul(_A, x2)), _B)

@@ -34,6 +34,13 @@ class Counter:
     def value(self, **labels) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def values(self) -> dict:
+        """Snapshot of every labelled series: {(sorted label items): value}.
+        Used by bench/cache reports to enumerate series without knowing the
+        label sets in advance."""
+        with self._lock:
+            return dict(self._values)
+
     def expose(self) -> list[str]:
         out = [f"# TYPE {self.name} counter"]
         for key, v in sorted(self._values.items()):

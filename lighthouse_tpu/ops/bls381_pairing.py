@@ -11,8 +11,10 @@ Design notes (derived, not transliterated — the reference's backend is
 vendored C/assembly):
 
 * The Miller loop runs on the TWIST: Q stays in E'(Fq2) Jacobian
-  coordinates; no per-element untwisting into Fq12 (the host oracle in
-  crypto/bls12_381/pairing.py untwists — correct but scalar). Line
+  coordinates; no per-element untwisting into Fq12 (the slow host oracle in
+  crypto/bls12_381/pairing_reference.py untwists — correct but scalar; the
+  optimized host path in crypto/bls12_381/pairing.py now also stays on the
+  twist). Line
   functions are derived by clearing denominators of the affine tangent /
   chord slope against untwisted coordinates (x·w⁻², y·w⁻³, tower w²=v,
   w⁶=ξ):

@@ -263,15 +263,20 @@ def _per_block_processing_inner(
 
     if strategy == BlockSignatureStrategy.VERIFY_BULK:
         verifier = BlockSignatureVerifier(state, spec, E)
-        if proposal_already_verified:
-            verifier.include_all_signatures_except_proposal(
-                signed_block.message, ctxt
-            )
-        else:
-            verifier.include_all_signatures(signed_block, block_root, ctxt)
+        # assembly span: message/domain derivation + pubkey decompression
+        # (served by the bls decompression caches after the first block)
+        with span("signature_set_assembly"):
+            if proposal_already_verified:
+                verifier.include_all_signatures_except_proposal(
+                    signed_block.message, ctxt
+                )
+            else:
+                verifier.include_all_signatures(signed_block, block_root, ctxt)
         # own span: the signature batch is the stage the TPU backend
         # accelerates, so bench_block_import can price it separately from
-        # the rest of the (enclosing) state_transition span
+        # the rest of the (enclosing) state_transition span; the host
+        # backend nests bls_rlc_accumulate/bls_hash_to_g2/bls_pairing
+        # stage spans inside this one
         with span("signature_batch_verify", sets=len(verifier.sets)):
             sigs_ok = verifier.verify()
         if not sigs_ok:

@@ -12,16 +12,19 @@ from ..crypto import bls
 from ..types.chain_spec import ChainSpec, Domain, compute_signing_root
 from .accessors import compute_epoch_at_slot, get_domain
 
-# Decompressed-pubkey cache: the reference keeps every validator pubkey
-# decompressed in memory (beacon_chain/src/validator_pubkey_cache.rs:17).
-_PUBKEY_CACHE: dict[bytes, bls.PublicKey] = {}
+# PublicKey OBJECT cache: the reference keeps every validator pubkey
+# decompressed in memory (validator_pubkey_cache.rs:17). Sized from the bls
+# point cache (one knob, LIGHTHOUSE_TPU_BLS_PK_CACHE, tunes both). The
+# decompressed point tuples are allocated once in bls._PK_CACHE and shared
+# by reference into each PublicKey's memoized `_point`; this LRU only adds
+# the thin object wrappers, saving re-wrapping on the per-block lookup path.
+_PUBKEY_CACHE = bls.LruCache(bls._PK_CACHE.maxsize)
 
 
 def pubkey_from_bytes(data: bytes) -> bls.PublicKey:
     pk = _PUBKEY_CACHE.get(data)
     if pk is None:
-        pk = bls.PublicKey(data)
-        _PUBKEY_CACHE[data] = pk
+        pk = _PUBKEY_CACHE.setdefault(data, bls.PublicKey(data))
     return pk
 
 

@@ -25,6 +25,23 @@ from lighthouse_tpu.utils.compile_cache import enable_compile_cache  # noqa: E40
 enable_compile_cache()
 
 
+def pytest_sessionstart(session):
+    """Tier-1 guard: the BLS verification caches must export hit/miss
+    counters through the metrics registry (the bench JSON and /metrics
+    consumers rely on the series existing even at zero)."""
+    from lighthouse_tpu.crypto import bls  # noqa: F401 — registers counters
+    from lighthouse_tpu.metrics import REGISTRY
+
+    text = REGISTRY.expose()
+    for needle in ("bls_cache_hits_total", "bls_cache_misses_total"):
+        assert needle in text, (
+            f"BLS cache counter {needle} missing from metrics exposition"
+        )
+    stats = bls.cache_stats()
+    for cache in ("pubkey", "signature", "hash_to_g2"):
+        assert cache in stats, f"cache_stats() missing the {cache!r} cache"
+
+
 def pytest_collection_modifyitems(config, items):
     """Tier-1 guard: every test in the device/multichip files MUST carry
     the `slow` marker. Their kernels take minutes of XLA-CPU compile
