@@ -28,16 +28,50 @@ import socket
 import struct
 import threading
 
-from cryptography.exceptions import InvalidSignature, InvalidTag
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+# `cryptography` is an optional dependency: importing this module must
+# not fail without it (the node runs plaintext transports; only actually
+# ENABLING noise requires the primitives). Tests importorskip it.
+try:
+    from cryptography.exceptions import InvalidSignature, InvalidTag
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+    _CRYPTOGRAPHY_ERROR: ImportError | None = None
+except ImportError as _e:  # pragma: no cover - depends on the image
+    _CRYPTOGRAPHY_ERROR = _e
+
+    class _UnavailableMeta(type):
+        def __getattr__(cls, name):  # Ed25519PrivateKey.generate() etc.
+            _require_cryptography()
+
+    class _Unavailable(metaclass=_UnavailableMeta):
+        """Placeholder: raises on ANY use (construction or classmethod
+        access), never on import."""
+
+        def __init__(self, *a, **kw):
+            _require_cryptography()
+
+    InvalidSignature = InvalidTag = type("_NeverRaised", (Exception,), {})
+    Ed25519PrivateKey = Ed25519PublicKey = _Unavailable
+    X25519PrivateKey = X25519PublicKey = ChaCha20Poly1305 = _Unavailable
+
+
+def _require_cryptography():
+    """Raise a clear error at USE time when `cryptography` is missing."""
+    if _CRYPTOGRAPHY_ERROR is not None:
+        raise ImportError(
+            "the noise transport requires the optional 'cryptography' "
+            "package (X25519/Ed25519/ChaCha20-Poly1305 primitives); "
+            "install it with `pip install cryptography` or run with "
+            "noise disabled"
+        ) from _CRYPTOGRAPHY_ERROR
 
 PROTOCOL_NAME = b"Noise_XX_25519_ChaChaPoly_SHA256"  # exactly 32 bytes
 SIG_PREFIX = b"noise-libp2p-static-key:"

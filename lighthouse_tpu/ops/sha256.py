@@ -22,8 +22,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..utils.hash import hash32_concat
-
 # fmt: off
 _K = np.array([
     0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
@@ -126,15 +124,18 @@ def merkle_tree_levels(leaves):
     while nodes.shape[0] > max(_HOST_TOP, 1):
         nodes = sha256_pairs(nodes.reshape(-1, 16))
         levels.append(nodes)
-    # Finish on host.
+    # Finish on host (batched host hasher; ≤ _HOST_TOP rows per level).
+    from ..utils.sha256_batch import hash_rows
+
     host = np.asarray(nodes)
     while host.shape[0] > 1:
-        buf = host.astype(">u4").tobytes()
-        out = b"".join(
-            hash32_concat(buf[i : i + 32], buf[i + 32 : i + 64])
-            for i in range(0, len(buf), 64)
+        rows = host.astype(">u4").view(np.uint8).reshape(-1, 64)
+        host = (
+            np.ascontiguousarray(hash_rows(rows))
+            .view(">u4")
+            .astype(np.uint32)
+            .reshape(-1, 8)
         )
-        host = np.frombuffer(out, dtype=">u4").astype(np.uint32).reshape(-1, 8)
         levels.append(host)
     return levels[::-1]
 
@@ -144,6 +145,26 @@ def merkleize_device(leaves):
     n = leaves.shape[0]
     assert n & (n - 1) == 0, f"leaf count {n} not a power of two"
     return np.asarray(merkle_tree_levels(leaves)[0][0])
+
+
+def device_hash_rows(pairs: np.ndarray) -> np.ndarray:
+    """[n, 64] uint8 → [n, 32] uint8 two-to-one hashing on device.
+
+    Pads the row count to a power of two so each size class compiles once
+    (one fused kernel call for the whole batch). This is the `device`
+    mode of utils.sha256_batch.hash_rows — opt-in: on hosts without a
+    real accelerator the per-shape XLA compile dwarfs the hashing.
+    """
+    m = pairs.shape[0]
+    if m == 0:
+        return np.zeros((0, 32), dtype=np.uint8)
+    mp = 1 << (m - 1).bit_length()
+    words = np.zeros((mp, 16), dtype=np.uint32)
+    words[:m] = (
+        np.ascontiguousarray(pairs).view(">u4").astype(np.uint32).reshape(m, 16)
+    )
+    dig = np.asarray(sha256_pairs(words))[:m]
+    return dig.astype(">u4").view(np.uint8).reshape(m, 32)
 
 
 def bytes_to_words(data: bytes) -> np.ndarray:

@@ -32,6 +32,91 @@ _BLOCK_DEPTH = (_CHUNKS_PER_BLOCK - 1).bit_length()  # 10
 
 _U64_MAX = (1 << 64) - 1
 
+# Past this many tracked dirty indices, collapse to "everything dirty":
+# the consumer's full-rebuild path beats per-index bookkeeping anyway
+# (cached_tree_hash._REBUILD_FRACTION territory).
+_DIRTY_CAP = 1 << 16
+
+
+class _DirtyTracking:
+    """Dirty-index propagation shared by both persistent list flavors.
+
+    Every mutating entry point records the touched element index, so the
+    state-level hash caches (ssz/cached_tree_hash.py) re-hash only
+    touched Merkle paths instead of re-scanning or re-diffing the whole
+    registry. The protocol is token-based so a cache can PROVE the set is
+    an exact delta against what it committed:
+
+      * `_dirt_token` identifies the list's dirty *baseline*: the
+        invariant is "contents == snapshot-at-token + changes in _dirty".
+        `copy()` shares the token and duplicates the pending set (both
+        sides keep the same baseline); any wholesale rebuild issues a
+        fresh token with an empty set (fresh baseline).
+      * `drain_dirty()` hands the pending set to a consumer and advances
+        the baseline. A consumer whose committed token matches the
+        drained baseline may apply just those indices; anything else
+        must fall back to a full diff (the milhouse analog: reuse the
+        tree only when you can prove lineage).
+      * Overflowing `_DIRTY_CAP` degrades to indices=None ("everything
+        may have changed") — mass-churn sweeps pay one full batched
+        rebuild instead of set bookkeeping.
+    """
+
+    __slots__ = ()
+
+    def _init_dirt(self):
+        self._dirty: set[int] = set()
+        self._dirty_all = False
+        self._dirt_token: object = object()
+
+    def _copy_dirt_to(self, out):
+        out._dirty = set(self._dirty)
+        out._dirty_all = self._dirty_all
+        out._dirt_token = self._dirt_token
+
+    def _reset_dirt(self):
+        """Fresh baseline after a wholesale rebuild: no consumer has
+        committed the new token, so every cache full-diffs once."""
+        self._dirty = set()
+        self._dirty_all = False
+        self._dirt_token = object()
+
+    def _mark(self, idx: int):
+        if self._dirty_all:
+            return
+        self._dirty.add(idx)
+        if len(self._dirty) > _DIRTY_CAP:
+            self._dirty_all = True
+            self._dirty = set()
+
+    def _mark_span(self, start: int, stop: int):
+        if self._dirty_all:
+            return
+        if stop - start > _DIRTY_CAP or len(self._dirty) + (stop - start) > _DIRTY_CAP:
+            self._dirty_all = True
+            self._dirty = set()
+        else:
+            self._dirty.update(range(start, stop))
+
+    def drain_dirty(self):
+        """Consume the pending dirty set and advance the baseline.
+
+        Returns (base_token, indices | None): `indices` is None when the
+        tracker overflowed (treat as everything-dirty). After the call
+        the list's token is fresh — read it via `dirt_token` to record
+        the commit point.
+        """
+        base = self._dirt_token
+        indices = None if self._dirty_all else self._dirty
+        self._dirty = set()
+        self._dirty_all = False
+        self._dirt_token = object()
+        return base, indices
+
+    @property
+    def dirt_token(self):
+        return self._dirt_token
+
 
 def _fold_values(values, depth: int) -> bytes:
     """Pack uint64s into 32-byte chunks and fold to a subtree root at
@@ -67,8 +152,8 @@ class _Block:
         return self.root
 
 
-class PersistentList:
-    __slots__ = ("_blocks", "_owned")
+class PersistentList(_DirtyTracking):
+    __slots__ = ("_blocks", "_owned", "_dirty", "_dirty_all", "_dirt_token")
 
     def __init__(self, values=()):
         vals = [self._coerce(v) for v in values]
@@ -77,6 +162,7 @@ class PersistentList:
             for i in range(0, len(vals), BLOCK_ELEMS)
         ]
         self._owned = [True] * len(self._blocks)
+        self._init_dirt()
 
     @staticmethod
     def _coerce(v) -> int:
@@ -94,6 +180,7 @@ class PersistentList:
         out._blocks = list(self._blocks)
         out._owned = [False] * len(self._blocks)
         self._owned = [False] * len(self._blocks)
+        self._copy_dirt_to(out)  # same baseline, same pending dirt
         return out
 
     def _own(self, bi: int) -> _Block:
@@ -147,6 +234,7 @@ class PersistentList:
         bi, off = divmod(idx, BLOCK_ELEMS)
         if self._blocks[bi].items[off] != v:
             self._own(bi).items[off] = v
+            self._mark(idx)
 
     def _assign_slice(self, sl: slice, values):
         n = len(self)
@@ -159,6 +247,7 @@ class PersistentList:
             fresh = PersistentList(all_vals)
             self._blocks = fresh._blocks
             self._owned = fresh._owned
+            self._reset_dirt()  # wholesale rebuild: fresh hash baseline
             return
         # contiguous same-length assignment (the epoch sweep's
         # `balances[:] = ...`): touch only blocks whose contents change,
@@ -172,6 +261,7 @@ class PersistentList:
             new = vals[vi : vi + span]
             if blk.items[off : off + span] != new:
                 self._own(bi).items[off : off + span] = new
+                self._mark_span(i, i + span)
             i += span
             vi += span
 
@@ -182,6 +272,7 @@ class PersistentList:
         else:
             self._blocks.append(_Block([v]))
             self._owned.append(True)
+        self._mark(len(self) - 1)
 
     def __eq__(self, other):
         if isinstance(other, (PersistentList, list, tuple)):
@@ -196,6 +287,23 @@ class PersistentList:
         return f"PersistentList(len={n}, [{head}{', …' if n > 4 else ''}])"
 
     # -- hashing ----------------------------------------------------------
+
+    def to_chunk_array(self):
+        """Pack the whole list into an SSZ leaf matrix: [⌈n/4⌉, 32] uint8
+        (little-endian uint64 packing). The full-extraction path of the
+        state-level caches; dirty-index updates avoid this entirely."""
+        import numpy as np
+
+        n = len(self)
+        n_chunks = (n + 3) // 4
+        buf = np.zeros(n_chunks * 4, dtype=np.uint64)
+        pos = 0
+        for blk in self._blocks:
+            buf[pos : pos + len(blk.items)] = np.asarray(
+                blk.items, dtype=np.uint64
+            )
+            pos += len(blk.items)
+        return buf.view(np.uint8).reshape(-1, 32)  # little-endian hosts
 
     def hash_tree_root(self, limit_chunks: int) -> bytes:
         """Merkle root over the list's chunks zero-extended to
@@ -274,7 +382,7 @@ def _fold_root_chunks(roots: list[bytes]) -> bytes:
     return nodes[0]
 
 
-class PersistentContainerList:
+class PersistentContainerList(_DirtyTracking):
     """Structurally-shared list of SSZ Container elements — the milhouse
     `List<Validator>` backbone (consensus/types/src/beacon_state.rs:34,371):
     `copy()` is O(#blocks); per-element root memos + per-block subtree
@@ -290,7 +398,15 @@ class PersistentContainerList:
     list is next copied, at which point they are re-frozen (the block
     becomes shared again)."""
 
-    __slots__ = ("_blocks", "_owned", "elem_t", "_thawed")
+    __slots__ = (
+        "_blocks",
+        "_owned",
+        "elem_t",
+        "_thawed",
+        "_dirty",
+        "_dirty_all",
+        "_dirt_token",
+    )
 
     def __init__(self, values=(), elem_t=None):
         vals = list(values)
@@ -305,6 +421,7 @@ class PersistentContainerList:
         self._thawed = []
         for v in vals:
             v.__dict__["_frozen"] = True
+        self._init_dirt()
 
     # -- structural sharing ---------------------------------------------
 
@@ -320,6 +437,7 @@ class PersistentContainerList:
         out._owned = [False] * len(self._blocks)
         out._thawed = []
         self._owned = [False] * len(self._blocks)
+        self._copy_dirt_to(out)  # same baseline, same pending dirt
         return out
 
     def _own(self, bi: int) -> _CBlock:
@@ -367,6 +485,7 @@ class PersistentContainerList:
         bi, off = divmod(idx, CONTAINER_BLOCK)
         value.__dict__["_frozen"] = True
         self._own(bi).items[off] = value
+        self._mark(idx)
 
     def mutate(self, idx):
         """Write-safe element access: installs a clone of element `idx`
@@ -383,7 +502,19 @@ class PersistentContainerList:
         v.__dict__.pop("_thc_root", None)
         blk.items[off] = v
         self._thawed.append(v)
+        self._mark(idx)  # conservatively dirty: the clone exists to be written
         return v
+
+    def drain_dirty(self):
+        # A consumer is committing a root over the current contents:
+        # re-freeze the clones mutate() handed out. A later write through
+        # a stale handle would be invisible to the drained delta (the
+        # committed root would silently diverge) — raising
+        # FrozenElementError forces the writer back through mutate().
+        for v in self._thawed:
+            v.__dict__["_frozen"] = True
+        self._thawed = []
+        return super().drain_dirty()
 
     def append(self, value):
         value.__dict__["_frozen"] = True
@@ -392,6 +523,7 @@ class PersistentContainerList:
         else:
             self._blocks.append(_CBlock([value]))
             self._owned.append(True)
+        self._mark(len(self) - 1)
 
     def __eq__(self, other):
         if isinstance(other, (PersistentContainerList, list, tuple)):
@@ -447,106 +579,15 @@ def bulk_container_roots(elems: list) -> None:
 
     Requires a fixed-size container whose fields are basic uints, boolean,
     or ByteVector — the Validator shape. Falls back silently (memos left
-    unset) for other shapes; callers then pay the per-element path."""
-    import hashlib as _h
-
-    import numpy as np
-
-    from .core import ByteVector, boolean, uint8, uint16, uint32, uint64
+    unset) for other shapes; callers then pay the per-element path.
+    The columnar extraction + batched subtree fold is the shared
+    implementation in ssz/cached_tree_hash.py."""
+    from .cached_tree_hash import container_roots_columnar
 
     if not elems:
         return
-    cls = type(elems[0])
-    fields = cls._fields
-    n = len(elems)
-    nf = len(fields)
-    pad_f = 1
-    while pad_f < nf:
-        pad_f *= 2
-    chunks = np.zeros((n, pad_f, 32), dtype=np.uint8)
-    for fi, (fname, ftype) in enumerate(fields.items()):
-        col = [v.__dict__[fname] for v in elems]
-        if isinstance(ftype, type) and issubclass(ftype, ByteVector):
-            size = ftype.fixed_size()
-            buf = np.frombuffer(b"".join(col), dtype=np.uint8).reshape(n, size)
-            if size <= 32:
-                chunks[:, fi, :size] = buf
-            else:
-                # multi-chunk bytes field (pubkey: 48B → 2 chunks → 1 hash)
-                nch = (size + 31) // 32
-                pad_c = 1
-                while pad_c < nch:
-                    pad_c *= 2
-                sub = np.zeros((n, pad_c * 32), dtype=np.uint8)
-                sub[:, :size] = buf
-                while pad_c > 1:
-                    sub = _np_hash_pairs(sub.reshape(n * pad_c // 2, 64)).reshape(
-                        n, -1
-                    )
-                    pad_c //= 2
-                chunks[:, fi, :] = sub.reshape(n, 32)
-        elif isinstance(ftype, type) and issubclass(
-            ftype, (boolean, uint8, uint16, uint32, uint64)
-        ):
-            size = ftype.fixed_size()
-            arr = np.fromiter(col, dtype=np.uint64, count=n)
-            raw = arr.astype("<u8").view(np.uint8).reshape(n, 8)
-            chunks[:, fi, :size] = raw[:, :size]
-        else:
-            return  # unsupported shape: leave memos unset
-    # fold the field axis: pad_f chunks → 1 root per element
-    cur = chunks.reshape(n * pad_f // 2, 64)
-    width = pad_f
-    while width > 1:
-        cur = _np_hash_pairs(cur)
-        width //= 2
-        if width > 1:
-            cur = cur.reshape(n * width // 2, 64)
-    roots = cur.reshape(n, 32)
+    roots = container_roots_columnar(type(elems[0]), elems)
+    if roots is None:
+        return  # unsupported shape: leave memos unset
     for i, v in enumerate(elems):
         v.__dict__["_thc_root"] = roots[i].tobytes()
-
-
-_DEVICE_HASH_THRESHOLD = 1 << 17  # rows; below this, hashlib wins
-
-
-def _np_hash_pairs(pairs):
-    """[m, 64] uint8 → [m, 32] uint8 SHA-256 rows. Big batches ride the
-    device kernel (ops/sha256, one call); the rest use one C-speed
-    hashlib pass over a contiguous buffer (no per-row numpy objects)."""
-    import hashlib as _h
-
-    import numpy as np
-
-    m = pairs.shape[0]
-    if m >= _DEVICE_HASH_THRESHOLD:
-        try:
-            import jax
-
-            if jax.default_backend() == "cpu":
-                # the XLA-CPU kernel is ~20× slower than hashlib here;
-                # the device path is for real accelerators only
-                raise RuntimeError("cpu backend")
-            from ..ops.sha256 import sha256_pairs
-
-            # pad the row count to a power of two: one compiled shape per
-            # size class instead of one per call site
-            mp = 1 << (m - 1).bit_length()
-            words = np.zeros((mp, 16), dtype=np.uint32)
-            words[:m] = (
-                np.ascontiguousarray(pairs)
-                .view(">u4")
-                .astype(np.uint32)
-                .reshape(m, 16)
-            )
-            dig = np.asarray(sha256_pairs(words))[:m]
-            return dig.astype(">u4").view(np.uint8).reshape(m, 32)
-        except Exception:  # noqa: BLE001 — no device: fall through
-            pass
-    data = pairs.tobytes()
-    out = bytearray(m * 32)
-    mv = memoryview(data)
-    sha = _h.sha256
-    for i in range(m):
-        out[i * 32 : (i + 1) * 32] = sha(mv[i * 64 : (i + 1) * 64]).digest()
-    return np.frombuffer(bytes(out), dtype=np.uint8).reshape(m, 32)

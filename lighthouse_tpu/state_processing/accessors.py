@@ -260,11 +260,16 @@ def get_total_active_balance(state, E) -> int:
 
 
 def increase_balance(state, index: int, delta: int):
-    state.balances[index] += delta
+    # zero-delta rewards are common (empty committees); skipping the write
+    # keeps the registry's dirty-index tracker (ssz/persistent.py) from
+    # recording — and the hash cache from re-rooting — untouched paths
+    if delta:
+        state.balances[index] += delta
 
 
 def decrease_balance(state, index: int, delta: int):
-    state.balances[index] = max(0, state.balances[index] - delta)
+    if delta:
+        state.balances[index] = max(0, state.balances[index] - delta)
 
 
 # ---------------------------------------------------------------------------

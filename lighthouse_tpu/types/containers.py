@@ -206,8 +206,12 @@ def build_types(E: type) -> SimpleNamespace:
         finalized_checkpoint: Checkpoint
 
         # incremental per-field caches for the registry-scale fields
-        # (cached_tree_hash analog; beacon_state.rs:2002-2004)
+        # (cached_tree_hash analog; beacon_state.rs:2002-2004). The
+        # declaration of WHICH fields are registry-scale lives here with
+        # the layout, not in the cache (phase0 has no participation or
+        # inactivity fields — subclass families inherit and extend).
         hash_tree_root = _state_hash_tree_root
+        _THC_LIST_FIELDS = ("validators", "balances")
 
     class AggregateAndProof(Container):
         aggregator_index: uint64
@@ -294,8 +298,17 @@ def build_types(E: type) -> SimpleNamespace:
         next_sync_committee: SyncCommittee
 
         # Altair+ states are NOT subclasses of the phase0 BeaconState
-        # (different field layout), so they need their own hook
+        # (different field layout), so they need their own hook — and
+        # their own registry-scale field declaration (participation and
+        # inactivity lists join the cached set; Bellatrix+ inherit)
         hash_tree_root = _state_hash_tree_root
+        _THC_LIST_FIELDS = (
+            "validators",
+            "balances",
+            "previous_epoch_participation",
+            "current_epoch_participation",
+            "inactivity_scores",
+        )
 
     # -- Bellatrix (execution payloads) ------------------------------------
 

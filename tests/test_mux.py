@@ -13,6 +13,10 @@ from dataclasses import replace
 
 import pytest
 
+# the noise-over-mux integration tests need the optional `cryptography`
+# package (see network/noise.py's lazy import guard)
+pytest.importorskip("cryptography")
+
 from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
 from lighthouse_tpu.crypto import bls
 from lighthouse_tpu.network import NetworkService

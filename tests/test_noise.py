@@ -17,6 +17,11 @@ from dataclasses import replace
 
 import pytest
 
+# noise needs the optional `cryptography` package; the module itself
+# imports fine without it (lazy guard) but every test here exercises the
+# real primitives
+pytest.importorskip("cryptography")
+
 from lighthouse_tpu.crypto import bls
 from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
 from lighthouse_tpu.network import NetworkService

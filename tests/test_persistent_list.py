@@ -240,3 +240,104 @@ def test_chain_states_share_validator_blocks_and_roundtrip():
         assert rt.hash_tree_root() == st.hash_tree_root()
     finally:
         bls.set_backend(prev)
+
+
+# --- dirty-index propagation (the token protocol feeding the hash caches) ---
+
+
+def test_dirty_tracking_marks_and_drains():
+    from lighthouse_tpu.ssz.persistent import PersistentList
+
+    lst = PersistentList(range(100))
+    t0 = lst.dirt_token
+    base, dirty = lst.drain_dirty()
+    assert base is t0 and dirty == set()
+    assert lst.dirt_token is not t0  # drain advances the baseline
+
+    lst[7] = 99
+    lst[7] = 99  # no-op write: not dirty
+    lst[12] = 1
+    lst.append(5)
+    base, dirty = lst.drain_dirty()
+    assert dirty == {7, 12, 100}
+    base, dirty = lst.drain_dirty()
+    assert dirty == set()
+
+
+def test_dirty_tracking_overflow_degrades_to_all():
+    from lighthouse_tpu.ssz.persistent import _DIRTY_CAP, PersistentList
+
+    lst = PersistentList(range(_DIRTY_CAP + 10))
+    lst.drain_dirty()
+    lst[:] = [v + 1 for v in lst]  # mass churn beyond the cap
+    base, dirty = lst.drain_dirty()
+    assert dirty is None  # "everything may have changed"
+
+
+def test_dirty_baseline_tokens_cannot_collide_across_branches():
+    """The hazard the token protocol exists for: two copies diverge, each
+    gets drained by its own consumer — the post-drain tokens must differ,
+    so a cache that committed branch A can never accept branch B's dirt
+    as an exact delta."""
+    from lighthouse_tpu.ssz.persistent import PersistentList
+
+    orig = PersistentList(range(50))
+    a = orig.copy()
+    b = orig.copy()
+    assert a.dirt_token is b.dirt_token  # shared baseline at copy time
+    a[3] = 111
+    b[9] = 222
+    base_a, dirty_a = a.drain_dirty()
+    base_b, dirty_b = b.drain_dirty()
+    assert base_a is base_b  # same baseline...
+    assert dirty_a == {3} and dirty_b == {9}  # ...different exact deltas
+    assert a.dirt_token is not b.dirt_token  # post-drain: distinct lineages
+
+
+def test_copy_carries_pending_dirt():
+    """Mutations made before a copy() belong to BOTH sides: each side's
+    cache (sharing committed layers) needs them."""
+    from lighthouse_tpu.ssz.persistent import PersistentContainerList
+
+    _, vals = _mkvalidators(10)
+    lst = PersistentContainerList(vals)
+    lst.drain_dirty()
+    lst.mutate(4).effective_balance = 7
+    dup = lst.copy()
+    _, dirty_dup = dup.drain_dirty()
+    _, dirty_orig = lst.drain_dirty()
+    assert dirty_dup == {4} and dirty_orig == {4}
+
+
+def test_wholesale_rebuild_resets_baseline():
+    from lighthouse_tpu.ssz.persistent import PersistentList
+
+    lst = PersistentList(range(20))
+    t0 = lst.dirt_token
+    lst[::2] = [0] * 10  # stepped slice: the wholesale-rebuild path
+    assert lst.dirt_token is not t0  # fresh baseline: consumers full-diff
+    _, dirty = lst.drain_dirty()
+    assert dirty == set()
+
+
+def test_stale_mutate_handle_raises_after_root_commit():
+    """A mutate() handle kept across a root commit must not be silently
+    writable: its writes would be invisible to the drained dirty delta
+    and the committed root would diverge. The drain re-freezes handles,
+    so the stale write raises and the caller re-mutates."""
+    import pytest as _pytest
+
+    from lighthouse_tpu.ssz.core import FrozenElementError
+    from lighthouse_tpu.ssz.persistent import PersistentContainerList
+
+    _, vals = _mkvalidators(10)
+    lst = PersistentContainerList(vals)
+    v = lst.mutate(4)
+    v.effective_balance = 7
+    lst.drain_dirty()  # a cache committed a root over current contents
+    with _pytest.raises(FrozenElementError):
+        v.effective_balance = 9  # stale handle: must not corrupt silently
+    w = lst.mutate(4)  # the sanctioned path still works
+    w.effective_balance = 9
+    _, dirty = lst.drain_dirty()
+    assert dirty == {4}

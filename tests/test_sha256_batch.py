@@ -1,0 +1,97 @@
+"""Differential fuzz: numpy multi-buffer SHA-256 vs hashlib.
+
+The vectorized host hasher (utils/sha256_batch) backs the registry-scale
+Merkleization caches, so it must be bit-identical to OpenSSL for every
+batch size and message length — including the precomputed-pad-schedule
+fast path (`hash_rows_numpy`) and every dispatcher mode."""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.utils.sha256_batch import (
+    _BATCH_MIN,
+    _CHUNK,
+    hash_rows,
+    hash_rows_hashlib,
+    hash_rows_numpy,
+    sha256_batch,
+)
+
+
+def _expected(rows) -> bytes:
+    return b"".join(hashlib.sha256(bytes(r)).digest() for r in rows)
+
+
+def test_pair_hashing_matches_hashlib_across_batch_sizes():
+    rng = np.random.default_rng(1)
+    # straddle the chunking boundary and the empty/one-row edges
+    for n in (0, 1, 2, 3, 63, 64, 300, _CHUNK - 1, _CHUNK, _CHUNK + 5):
+        pairs = rng.integers(0, 256, (n, 64), dtype=np.uint8)
+        exp = _expected(pairs)
+        assert hash_rows_numpy(pairs).tobytes() == exp
+        assert hash_rows_hashlib(pairs).tobytes() == exp
+        assert hash_rows(pairs).tobytes() == exp
+
+
+def test_pair_hashing_fuzz_random_batches():
+    rng = np.random.default_rng(2)
+    pyrng = random.Random(2)
+    for _ in range(25):
+        n = pyrng.randrange(1, 500)
+        pairs = rng.integers(0, 256, (n, 64), dtype=np.uint8)
+        assert hash_rows_numpy(pairs).tobytes() == _expected(pairs)
+
+
+def test_general_length_fuzz():
+    """sha256_batch pads + multi-blocks arbitrary same-length messages;
+    sweep the padding boundaries (55/56/63/64...) and random lengths."""
+    rng = np.random.default_rng(3)
+    pyrng = random.Random(3)
+    lengths = [0, 1, 31, 32, 55, 56, 57, 63, 64, 65, 119, 120, 128, 200]
+    lengths += [pyrng.randrange(0, 400) for _ in range(10)]
+    for length in lengths:
+        n = pyrng.randrange(1, 40)
+        msgs = rng.integers(0, 256, (n, length), dtype=np.uint8)
+        assert sha256_batch(msgs).tobytes() == _expected(msgs), length
+
+
+def test_dispatcher_modes_agree(monkeypatch):
+    rng = np.random.default_rng(4)
+    pairs = rng.integers(0, 256, (_BATCH_MIN + 7, 64), dtype=np.uint8)
+    exp = _expected(pairs)
+    for mode in ("auto", "hashlib", "numpy"):
+        monkeypatch.setenv("LIGHTHOUSE_TPU_SHA256_MODE", mode)
+        assert hash_rows(pairs).tobytes() == exp, mode
+
+
+def test_device_mode_falls_back_to_host(monkeypatch):
+    """`device` must never be a correctness hazard: with the kernel
+    unusable (or on a cpu backend) the dispatcher still hashes right."""
+    monkeypatch.setenv("LIGHTHOUSE_TPU_SHA256_MODE", "device")
+    rng = np.random.default_rng(5)
+    pairs = rng.integers(0, 256, (33, 64), dtype=np.uint8)
+    assert hash_rows(pairs).tobytes() == _expected(pairs)
+
+
+def test_hash_rows_output_is_writable():
+    """Tree layers are mutated in place — a read-only result (frombuffer
+    over bytes) would break every sparse path update."""
+    rng = np.random.default_rng(6)
+    for fn in (hash_rows_numpy, hash_rows_hashlib, hash_rows):
+        out = fn(rng.integers(0, 256, (9, 64), dtype=np.uint8))
+        assert out.flags.writeable
+        out[0, 0] ^= 1  # must not raise
+
+
+def test_zero_copy_rows_unaffected_by_source_mutation():
+    """hash_rows_hashlib wraps its own bytearray; mutating the input
+    after the call must not change the returned digests."""
+    rng = np.random.default_rng(7)
+    pairs = rng.integers(0, 256, (17, 64), dtype=np.uint8)
+    out = hash_rows_hashlib(pairs)
+    snapshot = out.tobytes()
+    pairs[:] = 0
+    assert out.tobytes() == snapshot
