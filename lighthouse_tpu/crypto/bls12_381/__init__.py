@@ -39,6 +39,7 @@ from .curve import (
 )
 from .fields import P, R, X
 from .hash_to_curve import DST_G2_POP, hash_to_g2
+from .msm import msm, msm_naive
 from .pairing import multi_pairing, pairing, pairing_check
 
 __all__ = [
@@ -47,5 +48,6 @@ __all__ = [
     "g1_to_bytes", "g2_clear_cofactor", "g2_from_bytes", "g2_in_subgroup",
     "g2_is_on_curve", "g2_psi", "g2_to_bytes", "inf", "is_inf", "pt_add",
     "pt_double", "pt_eq", "pt_mul", "pt_mul_binary", "pt_neg", "to_affine",
-    "DST_G2_POP", "hash_to_g2", "multi_pairing", "pairing", "pairing_check",
+    "DST_G2_POP", "hash_to_g2", "msm", "msm_naive", "multi_pairing",
+    "pairing", "pairing_check",
 ]

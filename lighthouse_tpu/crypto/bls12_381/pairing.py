@@ -165,13 +165,23 @@ def pairing(p_g1, q_g2):
     )
 
 
-def multi_pairing(pairs):
-    """∏ e(P_i, Q_i) with a single shared final exponentiation — the
-    multi-pairing that batch verification amortizes over."""
+def miller_product(pairs):
+    """∏ f_{|x|,Qᵢ}(Pᵢ) for (Pᵢ ∈ G1, Qᵢ ∈ G2-on-the-twist) Jacobian pairs —
+    the Miller-loop half of `multi_pairing`, with no final exponentiation.
+    Line-function products are independent per pair, so this is the unit the
+    batch verifier shards across the host pool: multiply the per-shard
+    products, then run `final_exponentiation` once (lock-free pure Python,
+    safe in forked workers)."""
     f = F.F12_ONE
     for p_g1, q_g2 in pairs:
         f = F.f12_mul(f, miller_loop(to_affine(FQ2, q_g2), to_affine(FQ, p_g1)))
-    return final_exponentiation(f)
+    return f
+
+
+def multi_pairing(pairs):
+    """∏ e(P_i, Q_i) with a single shared final exponentiation — the
+    multi-pairing that batch verification amortizes over."""
+    return final_exponentiation(miller_product(pairs))
 
 
 def pairing_check(pairs) -> bool:

@@ -1,1 +1,21 @@
-from .mesh import batch_mesh, shard_batch
+"""Parallel execution helpers: device mesh sharding + the host fork pool.
+
+`host_pool` is import-light (no jax) and is what the BLS batch verifier
+pulls in; the mesh helpers import jax, so they are exposed lazily to keep
+host-only crypto paths from paying the device-runtime import.
+"""
+
+from . import host_pool  # noqa: F401
+
+_MESH_SYMBOLS = ("batch_mesh", "shard_batch", "replicated", "pad_to_multiple",
+                 "bucket_size")
+
+
+def __getattr__(name):
+    if name in _MESH_SYMBOLS or name == "mesh":
+        from . import mesh
+
+        if name == "mesh":
+            return mesh
+        return getattr(mesh, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

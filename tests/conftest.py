@@ -33,9 +33,18 @@ def pytest_sessionstart(session):
     from lighthouse_tpu.metrics import REGISTRY
 
     text = REGISTRY.expose()
-    for needle in ("bls_cache_hits_total", "bls_cache_misses_total"):
+    for needle in (
+        "bls_cache_hits_total",
+        "bls_cache_misses_total",
+        # PR 4: the host fork-pool task counter and the batch-verify path
+        # counter must exist at zero (bench/asserts read them eagerly)
+        'bls_pool_tasks_total{mode="inline"}',
+        'bls_pool_tasks_total{mode="fork"}',
+        'bls_batch_verify_total{path="msm"}',
+        'bls_batch_verify_total{path="serial"}',
+    ):
         assert needle in text, (
-            f"BLS cache counter {needle} missing from metrics exposition"
+            f"BLS counter series {needle} missing from metrics exposition"
         )
     stats = bls.cache_stats()
     for cache in ("pubkey", "signature", "hash_to_g2"):

@@ -1,0 +1,150 @@
+"""Host fork-pool lifecycle: sizing, degrade, reuse, and failure surface.
+
+The pool must be boring: identical verification results at any size,
+exceptions that surface instead of hanging, workers that survive across
+batches, and a task counter the metrics exposition always carries
+(tests/conftest.py asserts the eager registration at session start).
+"""
+
+import os
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.metrics import REGISTRY
+from lighthouse_tpu.parallel import host_pool
+from lighthouse_tpu.parallel.host_pool import BrokenProcessPool, HostPool
+
+
+@pytest.fixture(autouse=True)
+def fresh_pool():
+    bls.set_backend("host")
+    host_pool.reset_pool()
+    yield
+    host_pool.reset_pool()
+
+
+def _square(x):
+    return x * x
+
+
+def _boom(x):
+    raise RuntimeError("worker task exploded")
+
+
+def _exit_hard(x):
+    os._exit(13)  # simulate an OOM-killed worker: no exception, no result
+
+
+def _sets(n, n_msgs=4):
+    kps = bls.interop_keypairs(3)
+    out = []
+    for i in range(n):
+        m = bytes([i % n_msgs]) * 32
+        kp = kps[i % 3]
+        out.append(bls.SignatureSet(kp.sk.sign(m), [kp.pk], m))
+    return out
+
+
+def test_inline_degrade_at_size_leq_one():
+    for size in (0, 1):
+        p = HostPool(size)
+        assert p.inline
+        assert p.map(_square, [1, 2, 3]) == [1, 4, 9]
+        assert p._executor is None  # never forked
+
+
+def test_fork_pool_maps_in_order():
+    p = HostPool(4)
+    try:
+        assert p.map(_square, list(range(17))) == [x * x for x in range(17)]
+    finally:
+        p.shutdown()
+
+
+def test_results_identical_across_pool_sizes(monkeypatch):
+    sets = _sets(12)
+    expected = bls._BACKENDS["host"].verify_signature_sets_serial(
+        sets, random.Random(9)
+    )
+    assert expected is True
+    tampered = list(sets)
+    tampered[5] = bls.SignatureSet(
+        sets[4].signature, sets[5].pubkeys, sets[5].message
+    )
+    for size in ("0", "1", "4"):
+        monkeypatch.setenv(host_pool.ENV_VAR, size)
+        host_pool.reset_pool()
+        assert bls.verify_signature_sets(sets, random.Random(9)) is True, size
+        assert (
+            bls.verify_signature_sets(tampered, random.Random(9)) is False
+        ), size
+
+
+def test_env_resize_replaces_pool(monkeypatch):
+    monkeypatch.setenv(host_pool.ENV_VAR, "2")
+    p2 = host_pool.get_pool()
+    assert p2.size == 2 and host_pool.get_pool() is p2  # stable while env is
+    monkeypatch.setenv(host_pool.ENV_VAR, "3")
+    p3 = host_pool.get_pool()
+    assert p3.size == 3 and p3 is not p2
+
+
+def test_pool_survives_reuse_across_batches(monkeypatch):
+    monkeypatch.setenv(host_pool.ENV_VAR, "2")
+    sets = _sets(10)
+    assert bls.verify_signature_sets(sets, random.Random(1)) is True
+    p = host_pool.get_pool()
+    ex = p._executor
+    assert ex is not None  # really forked
+    assert bls.verify_signature_sets(sets, random.Random(2)) is True
+    assert host_pool.get_pool() is p and p._executor is ex  # same workers
+
+
+def test_worker_exception_propagates_from_map(monkeypatch):
+    monkeypatch.setenv(host_pool.ENV_VAR, "2")
+    with pytest.raises(RuntimeError, match="worker task exploded"):
+        host_pool.get_pool().map(_boom, [1, 2, 3])
+
+
+def test_worker_exception_is_verification_failure_not_a_hang(monkeypatch):
+    monkeypatch.setenv(host_pool.ENV_VAR, "2")
+    host_pool.reset_pool()
+    sets = _sets(8)
+    monkeypatch.setattr(bls, "_prep_chunk", _boom)
+    assert bls.verify_signature_sets(sets, random.Random(3)) is False
+
+
+def test_dead_worker_breaks_pool_then_recovers(monkeypatch):
+    monkeypatch.setenv(host_pool.ENV_VAR, "2")
+    p = host_pool.get_pool()
+    with pytest.raises(BrokenProcessPool):
+        p.map(_exit_hard, [1, 2, 3])
+    assert p._executor is None  # dead executor discarded, not leaked
+    # same pool object forks fresh workers and serves the next batch
+    assert p.map(_square, [5, 6]) == [25, 36]
+    assert bls.verify_signature_sets(_sets(6), random.Random(4)) is True
+
+
+def test_pool_task_counter_counts_modes(monkeypatch):
+    counter = REGISTRY.counter("bls_pool_tasks_total")
+    inline0 = counter.value(mode="inline")
+    fork0 = counter.value(mode="fork")
+    HostPool(1).map(_square, [1, 2])
+    assert counter.value(mode="inline") == inline0 + 2
+    p = HostPool(2)
+    try:
+        p.map(_square, [1, 2, 3])
+    finally:
+        p.shutdown()
+    assert counter.value(mode="fork") == fork0 + 3
+
+
+def test_shard_preserves_order_and_bounds():
+    assert host_pool.shard([], 4) == []
+    assert host_pool.shard([1, 2, 3], 1) == [[1, 2, 3]]
+    chunks = host_pool.shard(list(range(10)), 3)
+    assert len(chunks) <= 3  # contiguous ceil-split
+    assert [x for c in chunks for x in c] == list(range(10))
+    assert host_pool.shard([1], 8) == [[1]]

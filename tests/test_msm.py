@@ -1,0 +1,118 @@
+"""Differential fuzz: Pippenger bucketed MSM vs the per-point wNAF oracle.
+
+`crypto/bls12_381/msm.py` is what the RLC batch verifier's soundness rides
+on, so it is pinned against `msm_naive` (n independent `pt_mul` ladders —
+the pre-Pippenger production path) across the shapes the verifier feeds it:
+RLC-sized 64-bit scalars, zero scalars, duplicate points, infinity inputs,
+explicit window-size sweeps, and both groups.
+"""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto.bls12_381 import (
+    FQ,
+    FQ2,
+    G1_GEN,
+    G2_GEN,
+    R,
+    inf,
+    is_inf,
+    msm,
+    msm_naive,
+    pt_eq,
+    pt_mul,
+)
+from lighthouse_tpu.crypto.bls12_381.msm import _signed_digits, window_size
+
+rng = random.Random(0xB10C)
+
+
+def _points(k, gen, n):
+    """n pseudo-random small multiples of the generator (cheap ladders)."""
+    return [pt_mul(k, gen, rng.randrange(1, 1 << 20)) for _ in range(n)]
+
+
+@pytest.mark.parametrize("k,gen", [(FQ, G1_GEN), (FQ2, G2_GEN)], ids=["g1", "g2"])
+def test_msm_matches_wnaf_random_sizes(k, gen):
+    # random n ∈ {1..257}: below, at, and above the bucketing threshold
+    sizes = [1, 2, 3, 7, 8, 9] + (
+        [rng.randrange(1, 258) for _ in range(4)] + [257]
+        if k is FQ
+        else [rng.randrange(10, 65)]  # G2 adds are 3×; keep runtime sane
+    )
+    for n in sizes:
+        pts = _points(k, gen, n)
+        ss = [rng.getrandbits(64) for _ in range(n)]  # RLC-sized scalars
+        assert pt_eq(k, msm(k, pts, ss), msm_naive(k, pts, ss)), n
+
+
+@pytest.mark.parametrize("window", [1, 2, 3, 5, 8, 13])
+def test_msm_window_sweep(window):
+    pts = _points(FQ, G1_GEN, 33)
+    ss = [rng.getrandbits(64) for _ in range(33)]
+    expect = msm_naive(FQ, pts, ss)
+    assert pt_eq(FQ, msm(FQ, pts, ss, window=window), expect)
+
+
+@pytest.mark.parametrize("k,gen", [(FQ, G1_GEN), (FQ2, G2_GEN)], ids=["g1", "g2"])
+def test_msm_zero_scalars_and_infinity_points(k, gen):
+    pts = _points(k, gen, 12)
+    pts[3] = inf(k)
+    pts[7] = inf(k)
+    ss = [rng.getrandbits(64) for _ in range(12)]
+    ss[0] = 0
+    ss[7] = 0  # zero scalar on an infinity point too
+    ss[11] = 0
+    # force the bucketed path even though only 8 contributors remain
+    got = msm(k, pts, ss, window=4)
+    assert pt_eq(k, got, msm_naive(k, pts, ss))
+    # degenerate: everything vanishes
+    assert is_inf(k, msm(k, pts, [0] * 12))
+    assert is_inf(k, msm(k, [inf(k)] * 5, [1, 2, 3, 4, 5]))
+    assert is_inf(k, msm(k, [], []))
+
+
+def test_msm_duplicate_points_and_negative_scalars():
+    base = _points(FQ, G1_GEN, 4)
+    pts = base + base + [base[0]] * 8  # heavy duplication → bucket collisions
+    ss = [rng.getrandbits(64) for _ in range(len(pts))]
+    assert pt_eq(FQ, msm(FQ, pts, ss), msm_naive(FQ, pts, ss))
+    ss_neg = [s if i % 3 else -s for i, s in enumerate(ss)]
+    assert pt_eq(FQ, msm(FQ, pts, ss_neg), msm_naive(FQ, pts, ss_neg))
+
+
+def test_msm_full_width_scalars():
+    # order-sized scalars (the verifier only feeds 64-bit, but the seam the
+    # Pallas backend slots behind must be width-generic)
+    pts = _points(FQ, G1_GEN, 9)
+    ss = [rng.randrange(R) for _ in range(9)]
+    assert pt_eq(FQ, msm(FQ, pts, ss), msm_naive(FQ, pts, ss))
+
+
+def test_msm_single_point_equals_pt_mul():
+    p = _points(FQ2, G2_GEN, 1)[0]
+    s = rng.getrandbits(64)
+    assert pt_eq(FQ2, msm(FQ2, [p], [s], window=6), pt_mul(FQ2, p, s))
+
+
+def test_msm_length_mismatch_raises():
+    with pytest.raises(ValueError):
+        msm(FQ, [G1_GEN], [1, 2])
+
+
+def test_signed_digits_reconstruct():
+    for _ in range(50):
+        c = rng.randrange(1, 13)
+        s = rng.getrandbits(rng.randrange(1, 130))
+        digits = _signed_digits(s, c)
+        half = 1 << (c - 1)
+        assert all(-half <= d <= half for d in digits)
+        assert sum(d << (c * i) for i, d in enumerate(digits)) == s
+
+
+def test_window_size_monotone_sane():
+    # the heuristic must stay in bounds and grow with n
+    assert 1 <= window_size(1, 64) <= 16
+    assert window_size(4096, 64) >= window_size(16, 64)
