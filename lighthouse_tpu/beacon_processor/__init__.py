@@ -32,17 +32,21 @@ class WorkType(enum.IntEnum):
     enum's ~32 variants collapse to the kinds this node implements."""
 
     CHAIN_SEGMENT = 0
-    GOSSIP_BLOCK = 1
-    GOSSIP_BLOB_SIDECAR = 2
-    GOSSIP_AGGREGATE = 3
-    GOSSIP_ATTESTATION = 4
-    UNKNOWN_BLOCK_ATTESTATION = 5
-    API_REQUEST = 6
-    BACKFILL_SYNC = 7
+    #: lookup-recovered blocks (Work::RpcBlock): ahead of gossip blocks —
+    #: a recovered parent chain unblocks held gossip work
+    RPC_BLOCK = 1
+    GOSSIP_BLOCK = 2
+    GOSSIP_BLOB_SIDECAR = 3
+    GOSSIP_AGGREGATE = 4
+    GOSSIP_ATTESTATION = 5
+    UNKNOWN_BLOCK_ATTESTATION = 6
+    API_REQUEST = 7
+    BACKFILL_SYNC = 8
 
 
 _QUEUE_BOUNDS = {
     WorkType.CHAIN_SEGMENT: 64,
+    WorkType.RPC_BLOCK: 64,
     WorkType.GOSSIP_BLOCK: 1024,
     WorkType.GOSSIP_BLOB_SIDECAR: 1024,
     WorkType.GOSSIP_AGGREGATE: 4096,

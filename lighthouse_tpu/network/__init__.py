@@ -19,8 +19,9 @@ and discv5 packet crypto (discovery uses its own UDP record protocol).
 Components: `NetworkService` (service/mod.rs analog) owning the server +
 peer set, `GossipRouter` (socket/handler bridge around
 gossipsub.GossipsubBehaviour), `PeerManager` (scoring/banning,
-peer_manager/peerdb/score.rs), `SyncManager` (range sync,
-network/src/sync/manager.rs)."""
+peer_manager/peerdb/score.rs), and the sync engine (network/sync/:
+multi-peer range sync, resumable backfill, unknown-root block lookups —
+sync/manager.rs + range_sync/ + backfill_sync/ + block_lookups/)."""
 
 from __future__ import annotations
 
@@ -29,6 +30,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..beacon_processor import BeaconProcessor, ReprocessQueue, WorkEvent, WorkType
 from ..metrics import inc_counter, set_gauge
 from ..utils.logging import get_logger
 from . import messages as M
@@ -52,8 +54,14 @@ from .rpc import (
 log = get_logger("lighthouse_tpu.network")
 
 # peer scoring (peerdb/score.rs shape)
+# (the sync engine imports these lazily at call time — network.sync is
+# imported below, after the constants it needs exist)
 SCORE_INVALID_MESSAGE = -10.0
 SCORE_TIMELY_MESSAGE = 0.5
+# failed/timed-out RPC (PeerAction::MidToleranceError class): mild — an
+# unresponsive peer drifts down instead of staying pristine while honest
+# peers absorb implication penalties
+SCORE_RPC_FAILURE = -1.0
 BAN_THRESHOLD = -40.0
 MAX_SCORE = 100.0
 BAN_DURATION = 3600.0  # bans expire (peerdb's ban period); entry then drops
@@ -333,160 +341,9 @@ class GossipRouter:
                 continue
 
 
-class SyncManager:
-    """Range sync (sync/manager.rs): on a Status showing the peer ahead,
-    pull BlocksByRange batches and feed process_chain_segment."""
-
-    EPOCHS_PER_BATCH = 2
-
-    def __init__(self, service: "NetworkService"):
-        self.service = service
-
-    def backfill(self, peer: Peer, verify_signatures: bool = True) -> int:
-        """Backfill sync (sync/backfill_sync/mod.rs:1-9): after a
-        checkpoint start, pull history BACKWARD from the anchor, verifying
-        the hash chain (and proposer signatures in one batch against the
-        anchor registry — it is append-only, so every historic proposer is
-        in it). Blocks land in the store without state transition."""
-        chain = self.service.chain
-        anchor_root = chain.genesis_block_root
-        anchor = chain._blocks_by_root.get(anchor_root) or chain.store.get_block(
-            anchor_root
-        )
-        if anchor is None or anchor.message.slot == 0:
-            return 0
-        expected_root = bytes(anchor.message.parent_root)
-        oldest_slot = int(anchor.message.slot)
-        stored = 0
-        batch = self.EPOCHS_PER_BATCH * chain.E.SLOTS_PER_EPOCH
-        while oldest_slot > 0:
-            start = max(0, oldest_slot - batch)
-            blocks = peer.client.blocks_by_range(
-                start, oldest_slot - start, self.service.decode_block
-            )
-            if not blocks:
-                break
-            # walk backward collecting the chain-linked subset, then verify
-            # the whole batch's proposer signatures in ONE RLC batch before
-            # any of it is stored
-            linked = []
-            for signed in reversed(blocks):
-                root = signed.message.hash_tree_root()
-                if root != expected_root:
-                    continue  # not on our chain (peer included forks)
-                linked.append((root, signed))
-                expected_root = bytes(signed.message.parent_root)
-            if not linked:
-                break
-            if verify_signatures and not _verify_backfill_signatures(
-                [s for _, s in linked], chain
-            ):
-                self.service.peers.report(peer.peer_id, SCORE_INVALID_MESSAGE)
-                return stored
-            for root, signed in linked:
-                # store only: backfilled history is cold data, served from
-                # the store (pinning it in the hot block map would never be
-                # pruned for pre-anchor slots)
-                chain.store.put_block(root, signed)
-                oldest_slot = int(signed.message.slot)
-                stored += 1
-        inc_counter("backfill_blocks_stored_total", amount=stored)
-        return stored
-
-    def sync_with(self, peer: Peer) -> int:
-        chain = self.service.chain
-        status = peer.client.status(self.service.local_status())
-        peer.status = status
-        imported_total = 0
-        batch = self.EPOCHS_PER_BATCH * chain.E.SLOTS_PER_EPOCH
-        while int(status.head_slot) > chain.head_state.slot:
-            start = chain.head_state.slot + 1
-            blocks = peer.client.blocks_by_range(
-                start, batch, self.service.decode_block
-            )
-            if not blocks:
-                break
-            self._couple_blobs(peer, blocks)
-            result = chain.process_chain_segment(blocks)
-            imported_total += result.imported
-            inc_counter("sync_blocks_imported_total", amount=result.imported)
-            if result.error is not None:
-                self.service.peers.report(peer.peer_id, SCORE_INVALID_MESSAGE)
-                break
-            if result.imported == 0:
-                break
-        return imported_total
-
-    def _couple_blobs(self, peer: Peer, blocks):
-        """Block/sidecar coupling (sync/block_sidecar_coupling.rs):
-        commitment-carrying range blocks need their sidecars staged in the
-        DA checker before the segment can import."""
-        chain = self.service.chain
-        wanted = []
-        now = chain.slot_clock.now()
-        for signed in blocks:
-            commitments = getattr(
-                signed.message.body, "blob_kzg_commitments", None
-            )
-            if commitments and not chain.block_within_da_window(
-                signed.message.slot, now
-            ):
-                continue  # peers have pruned these; import skips the gate
-            if commitments:
-                root = signed.message.hash_tree_root()
-                for i in range(len(commitments)):
-                    wanted.append(
-                        M.BlobIdentifier(block_root=root, index=i)
-                    )
-        if not wanted:
-            return
-        t = chain.types
-        sidecars = peer.client.blob_sidecars_by_root(
-            wanted, t.BlobSidecar.deserialize
-        )
-        by_root: dict[bytes, list] = {}
-        for sc in sidecars:
-            r = sc.signed_block_header.message.hash_tree_root()
-            by_root.setdefault(r, []).append(sc)
-        for root, scs in by_root.items():
-            try:
-                chain.process_blob_sidecars(
-                    root, scs, verify_header_signature=False
-                )
-            except Exception:  # noqa: BLE001 — bad sidecar: penalize, move on
-                self.service.peers.report(peer.peer_id, SCORE_INVALID_MESSAGE)
-                # the affected block then fails its DA gate in the segment
-                # import, which reports the batch outcome normally
-
-
-def _verify_backfill_signatures(blocks, chain) -> bool:
-    """One RLC batch over backfilled proposer signatures. The anchor
-    state's registry is append-only, so every historic proposer index
-    resolves in it; domains come from the fork schedule, not a state."""
-    from ..crypto import bls
-    from ..types.chain_spec import Domain, compute_signing_root
-
-    state = chain.head_state
-    spec = chain.spec
-    sets = []
-    for signed in blocks:
-        m = signed.message
-        if m.proposer_index >= len(state.validators):
-            return False
-        pubkey = bls.PublicKey(bytes(state.validators[m.proposer_index].pubkey))
-        epoch = m.slot // chain.E.SLOTS_PER_EPOCH
-        domain = spec.compute_domain_from_parts(
-            Domain.BEACON_PROPOSER,
-            spec.fork_version_at_epoch(epoch),
-            bytes(state.genesis_validators_root),
-        )
-        root = compute_signing_root(m.hash_tree_root(), domain)
-        sets.append(
-            bls.SignatureSet.single(
-                bls.Signature(bytes(signed.signature)), pubkey, root
-            )
-        )
-    return bls.get_backend().verify_signature_sets(sets)
+# the sync engine lives in its own package (network/sync/); imported here
+# AFTER the score constants it references at call time
+from .sync import SyncConfig, SyncManager  # noqa: E402
 
 
 class NetworkService:
@@ -510,6 +367,8 @@ class NetworkService:
         gossip_params=None,
         gossip_thresholds=None,
         gossip_config=None,
+        sync_config=None,
+        processor_workers: int = 2,
     ):
         self.chain = chain
         self.spec = chain.spec
@@ -518,7 +377,15 @@ class NetworkService:
         # handshake, as the reference's transport builder does
         self.transport = transport
         self.peers = PeerManager()
-        self.sync = SyncManager(self)
+        # the node's prioritized work-queue scheduler: sync segments and
+        # backfill windows queue here (CHAIN_SEGMENT / BACKFILL_SYNC), and
+        # unknown-block work parks in the reprocess queue until its block
+        # lands (the NetworkBeaconProcessor wiring)
+        self.processor = BeaconProcessor(
+            num_workers=processor_workers, name="network_beacon_processor"
+        )
+        self.reprocess = ReprocessQueue()
+        self.sync = SyncManager(self, config=sync_config)
         self.metadata_seq = 1
         self.server = RpcServer(self, host, port)
         self.port = self.server.port
@@ -654,6 +521,7 @@ class NetworkService:
 
     def stop(self):
         self._stopping = True
+        self.sync.stop()
         if self.discovery is not None:
             self.discovery.stop()
         for p in self.peers.peers():
@@ -663,6 +531,7 @@ class NetworkService:
                 pass
             self._drop_peer(p)
         self.server.stop()
+        self.processor.shutdown()
 
     # -- identity / status ------------------------------------------------------
 
@@ -811,20 +680,36 @@ class NetworkService:
 
     def _on_gossip_block(self, data: bytes):
         signed = self.decode_block(data)
-        from ..beacon_chain.chain import BlobsUnavailableError
+        from ..beacon_chain.chain import BlobsUnavailableError, BlockError
 
         try:
-            self.chain.process_block(signed)
+            root = self.chain.process_block(signed)
         except BlobsUnavailableError:
             # expected ordering race, not peer fault: the block is staged
             # in the DA checker; the completing sidecar's handler imports
             # it (no downscore for the forwarder)
             log.info("block waiting on sidecars", slot=signed.message.slot)
             return
+        except BlockError as e:
+            if "parent unknown" in str(e):
+                # not the forwarder's fault either: WE are missing the
+                # ancestry — recover it via a parent lookup instead of
+                # downscoring (sync/block_lookups parent-chain path)
+                log.info(
+                    "gossip block has unknown parent; starting lookup",
+                    slot=signed.message.slot,
+                )
+                self.sync.on_unknown_parent_block(signed)
+                return
+            raise
+        # release work parked under this root (attestations that arrived
+        # before the block, the usual out-of-order gossip case) — without
+        # this, only lookup-recovered blocks would ever drain the queue
+        self.reprocess.block_imported(root, self.processor)
         log.info(
             "gossip block imported",
             slot=signed.message.slot,
-            root=signed.message.hash_tree_root().hex()[:12],
+            root=root.hex()[:12],
         )
 
     def _on_gossip_attestation(self, data: bytes):
@@ -832,7 +717,29 @@ class NetworkService:
         att = t.Attestation.deserialize(data)
         results = self.chain.process_attestation_batch([att])
         if results and isinstance(results[0], Exception):
-            raise results[0]
+            err = results[0]
+            if "unknown beacon block root" in str(err):
+                # hold the attestation until its block lands (the
+                # work_reprocessing_queue path) and go find the block
+                root = bytes(att.data.beacon_block_root)
+                self.reprocess.hold_for_block(
+                    root,
+                    WorkEvent(
+                        WorkType.UNKNOWN_BLOCK_ATTESTATION,
+                        att,
+                        self._reprocess_attestation,
+                    ),
+                )
+                self.sync.on_unknown_block_root(root)
+                return
+            raise err
+
+    def _reprocess_attestation(self, att):
+        """Reprocess-queue re-fire: the unknown block imported, so the held
+        attestation gets its real verification pass now."""
+        results = self.chain.process_attestation_batch([att])
+        if results and isinstance(results[0], Exception):
+            raise results[0]  # worker counts it in beacon_processor_errors
 
     def _on_gossip_aggregate(self, data: bytes):
         t = self.chain.types
@@ -875,6 +782,7 @@ class NetworkService:
             block_root
         ):
             self.chain.process_block(avail.block)
+            self.reprocess.block_imported(block_root, self.processor)
 
     # -- publishing -------------------------------------------------------------
 

@@ -245,45 +245,57 @@ class RpcServer:
             self._respond(sock, RESP_SUCCESS, M.GoodbyeReason(reason=0).serialize())
         elif proto == M.PROTO_BLOCKS_BY_RANGE:
             req = M.BlocksByRangeRequest.deserialize(_recv_block(sock))
-            if req.count > MAX_REQUEST_BLOCKS or req.step != 1:
+            if req.step != 1:
                 self._respond(sock, RESP_INVALID_REQUEST, b"")
                 return
-            # cost = blocks requested (the reference prices by work asked)
-            if self._limited(sock, proto, int(req.count)):
+            # server-side cap: a hostile count is CLAMPED (the spec lets
+            # servers respond with fewer blocks), so one request can never
+            # stream the whole store — and the rate-limiter cost is priced
+            # on the clamped work actually asked for
+            count = min(int(req.count), MAX_REQUEST_BLOCKS)
+            if self._limited(sock, proto, count):
                 return
-            for signed in node.blocks_by_range(req.start_slot, req.count):
-                self._respond(sock, RESP_SUCCESS, signed.serialize())
-            sock.shutdown(socket.SHUT_WR)
+            self._stream(sock, node.blocks_by_range, req.start_slot, count)
         elif proto == M.PROTO_BLOCKS_BY_ROOT:
             req = M.BlocksByRootRequest.deserialize(_recv_block(sock))
-            if self._limited(sock, proto, max(1, len(list(req.roots)))):
+            roots = list(req.roots)[:MAX_REQUEST_BLOCKS]
+            if self._limited(sock, proto, max(1, len(roots))):
                 return
-            for signed in node.blocks_by_root(list(req.roots)):
-                self._respond(sock, RESP_SUCCESS, signed.serialize())
-            sock.shutdown(socket.SHUT_WR)
+            self._stream(sock, node.blocks_by_root, roots)
         elif proto == M.PROTO_BLOBS_BY_RANGE:
             req = M.BlobsByRangeRequest.deserialize(_recv_block(sock))
             # blob responses are ~128KiB each — the spec bounds this
             # protocol by sidecar count (MAX_REQUEST_BLOB_SIDECARS), not
-            # block count
+            # block count; clamp the block count to what fits the cap
             max_blobs = node.chain.E.MAX_BLOBS_PER_BLOCK
-            if req.count * max_blobs > MAX_REQUEST_BLOB_SIDECARS:
-                self._respond(sock, RESP_INVALID_REQUEST, b"")
+            count = min(int(req.count), MAX_REQUEST_BLOB_SIDECARS // max_blobs)
+            if self._limited(sock, proto, count * max_blobs):
                 return
-            if self._limited(sock, proto, int(req.count) * max_blobs):
-                return
-            for sc in node.blob_sidecars_by_range(req.start_slot, req.count):
-                self._respond(sock, RESP_SUCCESS, sc.serialize())
-            sock.shutdown(socket.SHUT_WR)
+            self._stream(sock, node.blob_sidecars_by_range, req.start_slot, count)
         elif proto == M.PROTO_BLOBS_BY_ROOT:
             req = M.BlobsByRootRequest.deserialize(_recv_block(sock))
-            if self._limited(sock, proto, max(1, len(list(req.blob_ids)))):
+            blob_ids = list(req.blob_ids)[:MAX_REQUEST_BLOB_SIDECARS]
+            if self._limited(sock, proto, max(1, len(blob_ids))):
                 return
-            for sc in node.blob_sidecars_by_root(list(req.blob_ids)):
-                self._respond(sock, RESP_SUCCESS, sc.serialize())
-            sock.shutdown(socket.SHUT_WR)
+            self._stream(sock, node.blob_sidecars_by_root, blob_ids)
         else:
             self._respond(sock, RESP_INVALID_REQUEST, b"")
+
+    def _stream(self, sock, provider, *args):
+        """Stream a provider's chunks. A provider fault becomes ONE
+        explicit SERVER_ERROR chunk instead of a silently-dying stream —
+        syncing clients must see the difference between "peer has nothing
+        here" (clean end-of-stream) and "peer failed mid-request" (retry
+        on another peer)."""
+        try:
+            items = provider(*args)
+        except Exception:  # noqa: BLE001 — provider fault, not stream fault
+            inc_counter("rpc_server_errors_total")
+            self._respond(sock, RESP_SERVER_ERROR, b"")
+            return
+        for item in items:
+            self._respond(sock, RESP_SUCCESS, item.serialize())
+        sock.shutdown(socket.SHUT_WR)
 
     @staticmethod
     def _respond(sock, result: int, payload: bytes):

@@ -31,6 +31,7 @@ def pytest_sessionstart(session):
     consumers rely on the series existing even at zero)."""
     from lighthouse_tpu.crypto import bls  # noqa: F401 — registers counters
     from lighthouse_tpu.metrics import REGISTRY
+    from lighthouse_tpu.network import sync  # noqa: F401 — registers sync series
 
     text = REGISTRY.expose()
     for needle in (
@@ -42,9 +43,23 @@ def pytest_sessionstart(session):
         'bls_pool_tasks_total{mode="fork"}',
         'bls_batch_verify_total{path="msm"}',
         'bls_batch_verify_total{path="serial"}',
+        # PR 5: the sync engine's gauge/counter series must exist at zero
+        # — the sync_catchup bench and dashboards read them eagerly
+        "sync_state",
+        'sync_batch_downloads_total{chain="range"}',
+        'sync_batch_downloads_total{chain="backfill"}',
+        'sync_batch_retries_total{chain="range"}',
+        'sync_batch_retries_total{chain="backfill"}',
+        'sync_batch_failures_total{chain="range"}',
+        'sync_batch_failures_total{chain="backfill"}',
+        'sync_lookups_started_total{kind="single"}',
+        'sync_lookups_started_total{kind="parent"}',
+        "sync_lookups_completed_total",
+        "sync_lookups_failed_total",
+        "sync_lookup_reprocess_drained_total",
     ):
         assert needle in text, (
-            f"BLS counter series {needle} missing from metrics exposition"
+            f"metric series {needle} missing from metrics exposition"
         )
     stats = bls.cache_stats()
     for cache in ("pubkey", "signature", "hash_to_g2"):
