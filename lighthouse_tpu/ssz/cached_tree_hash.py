@@ -407,23 +407,36 @@ class Uint64ListCache(_TokenListCache):
 class ContainerListCache(_TokenListCache):
     """Cache for a PersistentContainerList registry (validators): layer 0
     is the per-element container roots; dirty elements re-root through
-    the columnar batched subtree pass."""
+    the columnar batched subtree pass.
 
-    def root(self, value) -> bytes:
+    `row_source` (optional) is a callable(idx | None) -> [m, 32] element
+    root rows — the resident-column provider
+    (RegistryColumns.validator_root_rows), which assembles leaf matrices
+    straight from numpy columns so neither the sparse re-root nor the
+    mass-churn full path ever extracts Python validator objects."""
+
+    def root(self, value, row_source=None) -> bytes:
         n = len(value)
         idx_set = self._dirty_chunks(
             value, n, lambda d: {i for i in d if i < n}
         )
         if idx_set is None:
             _STATS["full_extracts"] += 1
-            rows = _element_root_rows(value.elem_t, list(value))
+            if row_source is not None:
+                rows = row_source(None)
+            else:
+                rows = _element_root_rows(value.elem_t, list(value))
             root = self.tree.update(rows)
         elif not idx_set:
             root = self.tree.root_only()
         else:
             idx = np.fromiter(sorted(idx_set), dtype=np.int64)
-            elems = [value[int(i)] for i in idx]
-            rows = _element_root_rows(value.elem_t, elems)
+            if row_source is not None:
+                rows = row_source(idx)
+            else:
+                rows = _element_root_rows(
+                    value.elem_t, [value[int(i)] for i in idx]
+                )
             root = self.tree.update_rows(idx, rows, n)
         self._committed = value.dirt_token
         return root
@@ -528,7 +541,22 @@ class BeaconStateHashCache:
 
             if isinstance(value, PersistentContainerList):
                 cache = self._cache_for(fname, ftype, ContainerListCache)
-                return mix_in_length(cache.root(value), len(value))
+                row_source = None
+                if fname == "validators":
+                    # resident columns, when attached: refresh() brings
+                    # them exactly up to date (token-proved), then they
+                    # serve element roots without touching objects
+                    cols = state.__dict__.get("_registry_columns")
+                    if cols is not None:
+                        if cols.try_refresh(state):
+                            row_source = cols.validator_root_rows
+                        else:
+                            # a mirrored field left the persistent
+                            # representation: detach, object path
+                            state.__dict__.pop("_registry_columns", None)
+                return mix_in_length(
+                    cache.root(value, row_source), len(value)
+                )
             if isinstance(value, PersistentList):
                 cache = self._cache_for(fname, ftype, Uint64ListCache)
                 return mix_in_length(cache.root(value), len(value))

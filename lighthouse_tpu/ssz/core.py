@@ -815,6 +815,13 @@ class Container(SSZType, metaclass=_ContainerMeta):
         cache = self.__dict__.get("_thc_cache")
         if cache is not None:
             out.__dict__["_thc_cache"] = cache.copy()
+        # resident registry columns (state_processing/registry_columns):
+        # carried across copies with per-column copy-on-write, exactly
+        # like the tree-hash layers — a copy shares every array until
+        # one side writes
+        cols = self.__dict__.get("_registry_columns")
+        if cols is not None:
+            out.__dict__["_registry_columns"] = cols.copy()
         return out
 
     # -- SSZType protocol ---------------------------------------------------

@@ -37,85 +37,157 @@ _U64_MAX = (1 << 64) - 1
 # (cached_tree_hash._REBUILD_FRACTION territory).
 _DIRTY_CAP = 1 << 16
 
+# Dirty channel consumed by the hash caches (ssz/cached_tree_hash.py) —
+# the default channel, so the original single-consumer API is unchanged.
+HASH_CHANNEL = "hash"
+
+
+class _DirtChannel:
+    """One consumer's view of a list's pending dirt (see _DirtyTracking)."""
+
+    __slots__ = ("dirty", "dirty_all", "token")
+
+    def __init__(self):
+        self.dirty: set[int] = set()
+        self.dirty_all = False
+        self.token: object = object()
+
+    def copy(self) -> "_DirtChannel":
+        out = _DirtChannel.__new__(_DirtChannel)
+        out.dirty = set(self.dirty)
+        out.dirty_all = self.dirty_all
+        out.token = self.token
+        return out
+
+    def reset(self):
+        self.dirty = set()
+        self.dirty_all = False
+        self.token = object()
+
 
 class _DirtyTracking:
     """Dirty-index propagation shared by both persistent list flavors.
 
-    Every mutating entry point records the touched element index, so the
-    state-level hash caches (ssz/cached_tree_hash.py) re-hash only
-    touched Merkle paths instead of re-scanning or re-diffing the whole
-    registry. The protocol is token-based so a cache can PROVE the set is
-    an exact delta against what it committed:
+    Every mutating entry point records the touched element index, so
+    consumers re-process only touched rows instead of re-scanning or
+    re-diffing the whole registry. There are two independent consumers —
+    the state-level hash caches (ssz/cached_tree_hash.py, the default
+    `HASH_CHANNEL`) and the resident registry columns
+    (state_processing/registry_columns.py) — so the dirt is tracked per
+    *channel*: every mark lands in every channel, and each consumer
+    drains only its own. The protocol is token-based so a consumer can
+    PROVE the set is an exact delta against what it committed:
 
-      * `_dirt_token` identifies the list's dirty *baseline*: the
-        invariant is "contents == snapshot-at-token + changes in _dirty".
-        `copy()` shares the token and duplicates the pending set (both
-        sides keep the same baseline); any wholesale rebuild issues a
-        fresh token with an empty set (fresh baseline).
-      * `drain_dirty()` hands the pending set to a consumer and advances
-        the baseline. A consumer whose committed token matches the
-        drained baseline may apply just those indices; anything else
-        must fall back to a full diff (the milhouse analog: reuse the
-        tree only when you can prove lineage).
-      * Overflowing `_DIRTY_CAP` degrades to indices=None ("everything
-        may have changed") — mass-churn sweeps pay one full batched
-        rebuild instead of set bookkeeping.
+      * each channel's `token` identifies that consumer's dirty
+        *baseline*: the invariant is "contents == snapshot-at-token +
+        changes in the channel's dirty set". `copy()` shares tokens and
+        duplicates pending sets (both sides keep the same baselines);
+        any wholesale rebuild issues fresh tokens with empty sets.
+      * `drain_dirty(channel)` hands the channel's pending set to its
+        consumer and advances that channel's baseline only. A consumer
+        whose committed token matches the drained baseline may apply
+        just those indices; anything else must fall back to a full
+        diff/rebuild (the milhouse analog: reuse the tree only when you
+        can prove lineage).
+      * Overflowing the class's `_dirty_cap` degrades a channel to
+        indices=None ("everything may have changed") — mass-churn sweeps
+        pay one full batched rebuild instead of set bookkeeping. The
+        container list raises the cap (see PersistentContainerList):
+        with columnar element roots, exact indices stay profitable far
+        past the uint64 lists' threshold.
     """
 
     __slots__ = ()
 
+    _dirty_cap = _DIRTY_CAP
+
     def _init_dirt(self):
-        self._dirty: set[int] = set()
-        self._dirty_all = False
-        self._dirt_token: object = object()
+        self._channels: dict[str, _DirtChannel] = {
+            HASH_CHANNEL: _DirtChannel()
+        }
 
     def _copy_dirt_to(self, out):
-        out._dirty = set(self._dirty)
-        out._dirty_all = self._dirty_all
-        out._dirt_token = self._dirt_token
+        out._channels = {k: ch.copy() for k, ch in self._channels.items()}
 
     def _reset_dirt(self):
-        """Fresh baseline after a wholesale rebuild: no consumer has
-        committed the new token, so every cache full-diffs once."""
-        self._dirty = set()
-        self._dirty_all = False
-        self._dirt_token = object()
+        """Fresh baselines after a wholesale rebuild: no consumer has
+        committed the new tokens, so every cache full-diffs once."""
+        for ch in self._channels.values():
+            ch.reset()
+
+    def channel(self, name: str) -> _DirtChannel:
+        """The named channel, created on first use. A fresh channel's
+        token has never been committed by its consumer, so the first
+        drain forces that consumer through its full-build path."""
+        ch = self._channels.get(name)
+        if ch is None:
+            ch = _DirtChannel()
+            self._channels[name] = ch
+        return ch
 
     def _mark(self, idx: int):
-        if self._dirty_all:
-            return
-        self._dirty.add(idx)
-        if len(self._dirty) > _DIRTY_CAP:
-            self._dirty_all = True
-            self._dirty = set()
+        cap = self._dirty_cap
+        for ch in self._channels.values():
+            if ch.dirty_all:
+                continue
+            ch.dirty.add(idx)
+            if len(ch.dirty) > cap:
+                ch.dirty_all = True
+                ch.dirty = set()
 
     def _mark_span(self, start: int, stop: int):
-        if self._dirty_all:
-            return
-        if stop - start > _DIRTY_CAP or len(self._dirty) + (stop - start) > _DIRTY_CAP:
-            self._dirty_all = True
-            self._dirty = set()
-        else:
-            self._dirty.update(range(start, stop))
+        cap = self._dirty_cap
+        for ch in self._channels.values():
+            if ch.dirty_all:
+                continue
+            if stop - start > cap or len(ch.dirty) + (stop - start) > cap:
+                ch.dirty_all = True
+                ch.dirty = set()
+            else:
+                ch.dirty.update(range(start, stop))
 
-    def drain_dirty(self):
-        """Consume the pending dirty set and advance the baseline.
+    def _mark_bulk(self, indices, exclude_channel: str | None = None):
+        """Record a (possibly huge) batch of dirty indices from a
+        vectorized store. `indices` is a numpy int array. The writer may
+        exclude its own channel: it already holds the stored values, so
+        marking itself would only trigger a redundant re-read."""
+        cap = self._dirty_cap
+        count = int(indices.size)
+        listed = None
+        for name, ch in self._channels.items():
+            if name == exclude_channel or ch.dirty_all:
+                continue
+            if count > cap or len(ch.dirty) + count > cap:
+                ch.dirty_all = True
+                ch.dirty = set()
+            else:
+                if listed is None:
+                    listed = indices.tolist()
+                ch.dirty.update(listed)
+
+    def drain_dirty(self, channel: str = HASH_CHANNEL):
+        """Consume the channel's pending dirty set and advance its
+        baseline.
 
         Returns (base_token, indices | None): `indices` is None when the
-        tracker overflowed (treat as everything-dirty). After the call
-        the list's token is fresh — read it via `dirt_token` to record
-        the commit point.
+        channel overflowed (treat as everything-dirty). After the call
+        the channel's token is fresh — read it via `dirt_token` /
+        `dirt_token_for` to record the commit point.
         """
-        base = self._dirt_token
-        indices = None if self._dirty_all else self._dirty
-        self._dirty = set()
-        self._dirty_all = False
-        self._dirt_token = object()
+        ch = self.channel(channel)
+        base = ch.token
+        indices = None if ch.dirty_all else ch.dirty
+        ch.dirty = set()
+        ch.dirty_all = False
+        ch.token = object()
         return base, indices
 
     @property
     def dirt_token(self):
-        return self._dirt_token
+        return self._channels[HASH_CHANNEL].token
+
+    def dirt_token_for(self, channel: str):
+        return self.channel(channel).token
 
 
 def _fold_values(values, depth: int) -> bytes:
@@ -153,7 +225,7 @@ class _Block:
 
 
 class PersistentList(_DirtyTracking):
-    __slots__ = ("_blocks", "_owned", "_dirty", "_dirty_all", "_dirt_token")
+    __slots__ = ("_blocks", "_owned", "_channels")
 
     def __init__(self, values=()):
         vals = [self._coerce(v) for v in values]
@@ -305,6 +377,63 @@ class PersistentList(_DirtyTracking):
             pos += len(blk.items)
         return buf.view(np.uint8).reshape(-1, 32)  # little-endian hosts
 
+    # -- bulk numpy interchange (the resident-columns fast path) -----------
+
+    def load_array(self):
+        """The whole list as a [n] uint64 array — one C-speed conversion
+        per block instead of a per-element Python iteration."""
+        import numpy as np
+
+        out = np.empty(len(self), dtype=np.uint64)
+        pos = 0
+        for blk in self._blocks:
+            out[pos : pos + len(blk.items)] = blk.items
+            pos += len(blk.items)
+        return out
+
+    def store_array(self, new, changed=None, exclude_channel=None) -> int:
+        """Bulk same-length store from a [n] uint64 array.
+
+        Only elements at `changed` (sorted int indices; computed by a
+        vectorized diff against the current contents when omitted) are
+        written and dirty-marked, so untouched shared blocks keep their
+        root memos and the hash caches see an exact delta. A writer that
+        mirrors the list (registry columns) passes its own channel as
+        `exclude_channel` — it already holds the stored values. Returns
+        the number of elements written.
+        """
+        import numpy as np
+
+        n = len(self)
+        new = np.ascontiguousarray(new, dtype=np.uint64)
+        if new.size != n:
+            raise ValueError(f"store_array length {new.size} != {n}")
+        if changed is None:
+            changed = np.nonzero(self.load_array() != new)[0]
+        if changed.size == 0:
+            return 0
+        pos = 0
+        ci = 0
+        for bi in range(len(self._blocks)):
+            blen = len(self._blocks[bi].items)
+            hi = int(np.searchsorted(changed, pos + blen))
+            if hi > ci:
+                blk = self._own(bi)
+                span = changed[ci:hi]
+                if span.size > blen // 4:
+                    # dense in this block: one slice-assign beats
+                    # per-index writes (tolist is a C conversion)
+                    blk.items[:] = new[pos : pos + blen].tolist()
+                else:
+                    vals = new[span].tolist()
+                    offs = (span - pos).tolist()
+                    for off, v in zip(offs, vals):
+                        blk.items[off] = v
+                ci = hi
+            pos += blen
+        self._mark_bulk(changed, exclude_channel)
+        return int(changed.size)
+
     def hash_tree_root(self, limit_chunks: int) -> bytes:
         """Merkle root over the list's chunks zero-extended to
         `limit_chunks` (no length mix — the SSZ List type mixes it). Cost:
@@ -398,15 +527,14 @@ class PersistentContainerList(_DirtyTracking):
     list is next copied, at which point they are re-frozen (the block
     becomes shared again)."""
 
-    __slots__ = (
-        "_blocks",
-        "_owned",
-        "elem_t",
-        "_thawed",
-        "_dirty",
-        "_dirty_all",
-        "_dirt_token",
-    )
+    __slots__ = ("_blocks", "_owned", "elem_t", "_thawed", "_channels")
+
+    # Exact dirty indices stay profitable far past the uint64 lists'
+    # threshold: each container element costs 7 batched hashes plus a
+    # Python field extraction to re-root, so even a third of a 1M
+    # registry (an epoch-boundary effective-balance sweep) is cheaper as
+    # a 333k-row sparse update than as a full columnar rebuild.
+    _dirty_cap = 1 << 20
 
     def __init__(self, values=(), elem_t=None):
         vals = list(values)
@@ -505,16 +633,53 @@ class PersistentContainerList(_DirtyTracking):
         self._mark(idx)  # conservatively dirty: the clone exists to be written
         return v
 
-    def drain_dirty(self):
-        # A consumer is committing a root over the current contents:
-        # re-freeze the clones mutate() handed out. A later write through
-        # a stale handle would be invisible to the drained delta (the
-        # committed root would silently diverge) — raising
-        # FrozenElementError forces the writer back through mutate().
+    def drain_dirty(self, channel: str = HASH_CHANNEL):
+        # A consumer is committing a snapshot (hash root OR column
+        # mirror) over the current contents: re-freeze the clones
+        # mutate() handed out. A later write through a stale handle
+        # would be invisible to the drained delta (the committed
+        # snapshot would silently diverge) — raising FrozenElementError
+        # forces the writer back through mutate().
         for v in self._thawed:
             v.__dict__["_frozen"] = True
         self._thawed = []
-        return super().drain_dirty()
+        return super().drain_dirty(channel)
+
+    def set_fields_bulk(self, indices, field: str, values):
+        """Bulk single-field writeback: replace element `i` with a
+        shallow clone carrying ``field=value`` for every (i, value) pair.
+
+        The epoch sweeps (hysteresis effective-balance updates, registry
+        eligibility/activation stores) write ONE field across many rows;
+        routing each through `mutate()` costs a full container deep-copy
+        per row (the r05 epoch-boundary bottleneck). Element fields are
+        immutable scalars/bytes (the Validator shape), so a `__dict__`
+        copy is an exact clone; the root memo is dropped, the clone is
+        installed frozen (no thaw handle to leak), and the dirty marks
+        land as one bulk batch.
+        """
+        import numpy as np
+
+        n = len(self)
+        blk = None
+        cur_bi = -1
+        for idx, val in zip(indices, values):
+            if not 0 <= idx < n:
+                raise IndexError(idx)
+            bi, off = divmod(idx, CONTAINER_BLOCK)
+            if bi != cur_bi:
+                blk = self._own(bi)
+                cur_bi = bi
+            v = blk.items[off]
+            cls = type(v)
+            new = cls.__new__(cls)
+            nd = new.__dict__
+            nd.update(v.__dict__)
+            nd.pop("_thc_root", None)
+            nd[field] = cls._fields[field].coerce(val)
+            nd["_frozen"] = True
+            blk.items[off] = new
+        self._mark_bulk(np.asarray(list(indices), dtype=np.int64))
 
     def append(self, value):
         value.__dict__["_frozen"] = True

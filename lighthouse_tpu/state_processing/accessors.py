@@ -12,7 +12,7 @@ from dataclasses import dataclass
 
 from ..types.chain_spec import FAR_FUTURE_EPOCH, ChainSpec, Domain
 from ..utils.hash import sha256 as hash_bytes
-from .shuffle import compute_shuffled_index, shuffle_list
+from .shuffle import compute_shuffled_index
 
 MAX_RANDOM_BYTE = 255
 
@@ -86,10 +86,41 @@ def is_slashable_attestation_data(data_1, data_2) -> bool:
     return double or surround
 
 
+def _fresh_columns(state):
+    """The state's resident registry columns brought exactly up to date,
+    or None for plain-list states. Refreshing drains the columns dirty
+    channel, which re-freezes any outstanding `mutate()` handles — call
+    sites must acquire write handles AFTER their accessor reads (the
+    pattern every state-transition mutator follows)."""
+    from .registry_columns import registry_columns_for
+
+    cols = registry_columns_for(state)
+    if cols is not None:
+        cols.refresh(state)
+    return cols
+
+
+def active_validator_indices_array(state, epoch: int):
+    """Active indices as an int64 array — one vectorized mask over the
+    resident columns instead of a per-validator Python sweep (falls back
+    to the object loop for plain-list states)."""
+    import numpy as np
+
+    cols = _fresh_columns(state)
+    if cols is not None:
+        return np.nonzero(cols.active_mask(epoch))[0]
+    return np.fromiter(
+        (
+            i
+            for i, v in enumerate(state.validators)
+            if is_active_validator(v, epoch)
+        ),
+        dtype=np.int64,
+    )
+
+
 def get_active_validator_indices(state, epoch: int) -> list[int]:
-    return [
-        i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)
-    ]
+    return active_validator_indices_array(state, epoch).tolist()
 
 
 # ---------------------------------------------------------------------------
@@ -128,24 +159,33 @@ def get_committee_count_per_slot(active_count: int, E) -> int:
 @dataclass
 class CommitteeCache:
     """One epoch's shuffling: every committee is a slice of `shuffled`
-    (committee_cache.rs analog)."""
+    (committee_cache.rs analog). The whole epoch's assignment is ONE
+    shuffled-permutation gather — active indices (a vectorized column
+    mask) indexed by the batched swap-or-not permutation — held as an
+    int64 array that committees slice zero-copy."""
 
     epoch: int
     seed: bytes
-    shuffled: list[int]
+    shuffled: "object"  # np.ndarray[int64]
     committees_per_slot: int
     slots_per_epoch: int
 
     @classmethod
     def build(cls, state, epoch: int, E) -> "CommitteeCache":
-        active = get_active_validator_indices(state, epoch)
+        from .shuffle import _shuffled_positions
+
+        active = active_validator_indices_array(state, epoch)
         seed = get_seed(state, epoch, Domain.BEACON_ATTESTER, E)
-        shuffled = shuffle_list(active, seed, E.SHUFFLE_ROUND_COUNT)
+        if active.size > 1:
+            perm = _shuffled_positions(active.size, seed, E.SHUFFLE_ROUND_COUNT)
+            shuffled = active[perm]
+        else:
+            shuffled = active
         return cls(
             epoch=epoch,
             seed=seed,
             shuffled=shuffled,
-            committees_per_slot=get_committee_count_per_slot(len(active), E),
+            committees_per_slot=get_committee_count_per_slot(active.size, E),
             slots_per_epoch=E.SLOTS_PER_EPOCH,
         )
 
@@ -165,7 +205,9 @@ class CommitteeCache:
         count = self.committee_count
         start = n * global_index // count
         end = n * (global_index + 1) // count
-        return self.shuffled[start:end]
+        # plain ints out: members land in SSZ containers, dict keys and
+        # signature sets — np.int64 leaking there is a foot-gun
+        return self.shuffled[start:end].tolist()
 
     def active_validator_count(self) -> int:
         return len(self.shuffled)
@@ -254,6 +296,17 @@ def get_total_balance(state, indices, E) -> int:
 
 
 def get_total_active_balance(state, E) -> int:
+    cols = _fresh_columns(state)
+    if cols is not None:
+        import numpy as np
+
+        epoch = get_current_epoch(state, E)
+        total = int(
+            cols.effective_balance[cols.active_mask(epoch)].sum(
+                dtype=np.uint64
+            )
+        )
+        return max(E.EFFECTIVE_BALANCE_INCREMENT, total)
     return get_total_balance(
         state, get_active_validator_indices(state, get_current_epoch(state, E)), E
     )
@@ -353,19 +406,36 @@ def initiate_validator_exit(state, index: int, spec: ChainSpec, E):
         return
     if state.validators[index].exit_epoch != FAR_FUTURE_EPOCH:
         return
-    v = mutable_validator(state, index)
-    exit_epochs = [
-        w.exit_epoch for w in state.validators if w.exit_epoch != FAR_FUTURE_EPOCH
-    ]
-    exit_queue_epoch = max(
-        exit_epochs
-        + [compute_activation_exit_epoch(get_current_epoch(state, E), E)]
-    )
-    exit_queue_churn = sum(
-        1 for w in state.validators if w.exit_epoch == exit_queue_epoch
-    )
+    # All queue reads happen BEFORE the mutate() handle is taken: the
+    # columns fast paths drain the dirty channel, which re-freezes any
+    # outstanding handles (a stale-handle write would be invisible to
+    # the drained delta).
+    cols = _fresh_columns(state)
+    floor = compute_activation_exit_epoch(get_current_epoch(state, E), E)
+    if cols is not None:
+        import numpy as np
+
+        ee = cols.exit_epoch
+        exiting = ee[ee != np.uint64(FAR_FUTURE_EPOCH)]
+        exit_queue_epoch = max(
+            int(exiting.max()) if exiting.size else 0, floor
+        )
+        exit_queue_churn = int(
+            (ee == np.uint64(exit_queue_epoch)).sum()
+        )
+    else:
+        exit_epochs = [
+            w.exit_epoch
+            for w in state.validators
+            if w.exit_epoch != FAR_FUTURE_EPOCH
+        ]
+        exit_queue_epoch = max(exit_epochs + [floor])
+        exit_queue_churn = sum(
+            1 for w in state.validators if w.exit_epoch == exit_queue_epoch
+        )
     if exit_queue_churn >= get_validator_churn_limit(state, spec, E):
         exit_queue_epoch += 1
+    v = mutable_validator(state, index)
     v.exit_epoch = exit_queue_epoch
     v.withdrawable_epoch = (
         exit_queue_epoch + spec.min_validator_withdrawability_delay

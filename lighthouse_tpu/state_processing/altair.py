@@ -357,50 +357,161 @@ def _validator_index_of(state, pubkey: bytes) -> int:
 
 
 class EpochArrays:
-    """Flat-array registry snapshot for one epoch transition — the TPU-side
-    layout (single_pass.rs's per-validator struct turned into columns)."""
+    """Flat-array registry view for one epoch transition — the TPU-side
+    layout (single_pass.rs's per-validator struct turned into columns).
 
-    def __init__(self, state, E):
+    Two backings:
+
+      * **resident** (`columns` given): every array is a live view of the
+        state's RegistryColumns — nothing is rebuilt, the transition
+        starts on whatever the last refresh left resident (the
+        zero-rebuild path at 1M validators). Balance/score sweeps go
+        through `load_*`/`store_*`, which diff against the resident
+        column and write only changed rows back into the persistent
+        lists (exact dirty indices to the hash caches).
+      * **legacy snapshot** (no columns): the per-validator
+        ``np.fromiter`` passes and ``tolist()`` writebacks of the r2-r5
+        era — kept verbatim as the per-validator oracle the bench's
+        vs_baseline control and the differential suite run against, and
+        as the fallback for plain-list states.
+    """
+
+    def __init__(self, state, E, columns=None):
         n = len(state.validators)
-        vs = state.validators
         self.n = n
-        self.effective_balance = np.fromiter(
-            (v.effective_balance for v in vs), dtype=np.uint64, count=n
+        self.columns = columns
+        self._snap: dict[str, np.ndarray] = {}
+        if columns is not None:
+            if columns.validator_count != n:
+                raise ValueError(
+                    "EpochArrays over stale columns: refresh() first"
+                )
+        else:
+            vs = state.validators
+            for name in (
+                "effective_balance",
+                "activation_epoch",
+                "exit_epoch",
+                "withdrawable_epoch",
+            ):
+                self._snap[name] = np.fromiter(
+                    (v.__dict__[name] for v in vs), dtype=np.uint64, count=n
+                )
+            self._snap["slashed"] = np.fromiter(
+                (v.slashed for v in vs), dtype=bool, count=n
+            )
+        if hasattr(state, "previous_epoch_participation"):
+            self.prev_participation = np.frombuffer(
+                state.previous_epoch_participation, dtype=np.uint8, count=n
+            )
+            self.curr_participation = np.frombuffer(
+                state.current_epoch_participation, dtype=np.uint8, count=n
+            )
+        else:  # phase0: no participation flags
+            self.prev_participation = None
+            self.curr_participation = None
+        self._state = state
+
+    def _col(self, name: str) -> np.ndarray:
+        if self.columns is not None:
+            return getattr(self.columns, name)
+        arr = self._snap.get(name)
+        if arr is None:
+            # snapshot columns the common stages don't need are built
+            # lazily (registry updates want eligibility; nothing else)
+            vs = self._state.validators
+            arr = np.fromiter(
+                (v.__dict__[name] for v in vs), dtype=np.uint64, count=self.n
+            )
+            self._snap[name] = arr
+        return arr
+
+    @property
+    def effective_balance(self) -> np.ndarray:
+        return self._col("effective_balance")
+
+    @property
+    def activation_eligibility_epoch(self) -> np.ndarray:
+        return self._col("activation_eligibility_epoch")
+
+    @property
+    def activation_epoch(self) -> np.ndarray:
+        return self._col("activation_epoch")
+
+    @property
+    def exit_epoch(self) -> np.ndarray:
+        return self._col("exit_epoch")
+
+    @property
+    def withdrawable_epoch(self) -> np.ndarray:
+        return self._col("withdrawable_epoch")
+
+    @property
+    def slashed(self) -> np.ndarray:
+        return self._col("slashed")
+
+    # -- balances / inactivity scores (the sweep's read-modify-write) ----
+
+    def load_balances(self, state) -> np.ndarray:
+        if self.columns is not None:
+            # re-sync first: object-path writes since the last refresh
+            # (electra queue stages, block ops) must land in the column
+            self.columns.refresh(state)
+            return self.columns.balances.copy()
+        return np.fromiter(state.balances, dtype=np.uint64, count=self.n)
+
+    def store_balances(self, state, new: np.ndarray):
+        if self.columns is not None:
+            self.columns.write_balances(state, new)
+        else:
+            state.balances[:] = new.tolist()
+
+    def load_inactivity_scores(self, state) -> np.ndarray:
+        if self.columns is not None:
+            self.columns.refresh(state)
+            return self.columns.inactivity_scores.copy()
+        return np.fromiter(
+            state.inactivity_scores, dtype=np.uint64, count=self.n
         )
-        self.activation_epoch = np.fromiter(
-            (v.activation_epoch for v in vs), dtype=np.uint64, count=n
-        )
-        self.exit_epoch = np.fromiter(
-            (v.exit_epoch for v in vs), dtype=np.uint64, count=n
-        )
-        self.withdrawable_epoch = np.fromiter(
-            (v.withdrawable_epoch for v in vs), dtype=np.uint64, count=n
-        )
-        self.slashed = np.fromiter(
-            (v.slashed for v in vs), dtype=bool, count=n
-        )
-        self.prev_participation = np.frombuffer(
-            state.previous_epoch_participation, dtype=np.uint8, count=n
-        )
-        self.curr_participation = np.frombuffer(
-            state.current_epoch_participation, dtype=np.uint8, count=n
-        )
+
+    def store_inactivity_scores(self, state, new: np.ndarray):
+        if self.columns is not None:
+            self.columns.write_inactivity_scores(state, new)
+        else:
+            state.inactivity_scores[:] = new.tolist()
 
     def refresh_rows(self, state, indices):
-        """Re-snapshot specific validators after targeted mutations
-        (registry updates touch a handful of rows; rebuilding all columns
-        per stage was the r2 bottleneck)."""
+        """Re-sync specific validators after targeted object mutations
+        (registry updates touch a handful of rows). Resident columns
+        consume the exact dirty-index drain instead of the caller's
+        list; the legacy snapshot re-reads the given rows."""
+        if self.columns is not None:
+            self.columns.refresh(state)
+            return
         for i in indices:
             v = state.validators[i]
-            self.effective_balance[i] = v.effective_balance
-            self.activation_epoch[i] = v.activation_epoch
-            self.exit_epoch[i] = v.exit_epoch
-            self.withdrawable_epoch[i] = v.withdrawable_epoch
-            self.slashed[i] = v.slashed
+            self._snap["effective_balance"][i] = v.effective_balance
+            self._snap["activation_epoch"][i] = v.activation_epoch
+            self._snap["exit_epoch"][i] = v.exit_epoch
+            self._snap["withdrawable_epoch"][i] = v.withdrawable_epoch
+            self._snap["slashed"][i] = v.slashed
+            if "activation_eligibility_epoch" in self._snap:
+                self._snap["activation_eligibility_epoch"][i] = (
+                    v.activation_eligibility_epoch
+                )
 
     def active_at(self, epoch: int) -> np.ndarray:
         e = np.uint64(epoch)
         return (self.activation_epoch <= e) & (e < self.exit_epoch)
+
+    def total_active_balance(self, epoch: int, E) -> int:
+        """Spec get_total_active_balance from the resident columns — the
+        1M-object Python sweep the accessor pays, as one masked sum."""
+        active = self.active_at(epoch)
+        return max(
+            int(self.effective_balance[active].sum(dtype=np.uint64)),
+            E.EFFECTIVE_BALANCE_INCREMENT,
+        )
 
     def unslashed_participating(self, flag_index: int, epoch_is_prev: bool):
         part = self.prev_participation if epoch_is_prev else self.curr_participation
@@ -463,7 +574,7 @@ def process_inactivity_updates(
         TIMELY_TARGET_FLAG_INDEX, True
     ) & prev_active
 
-    scores = np.fromiter(state.inactivity_scores, dtype=np.uint64, count=arrays.n)
+    scores = arrays.load_inactivity_scores(state)
     dec = eligible & participating
     scores[dec] -= np.minimum(np.uint64(1), scores[dec])
     inc = eligible & ~participating
@@ -471,7 +582,7 @@ def process_inactivity_updates(
     if not get_finality_delay(state, E) > E.MIN_EPOCHS_TO_INACTIVITY_PENALTY:
         recovery = np.uint64(spec.inactivity_score_recovery_rate)
         scores[eligible] -= np.minimum(recovery, scores[eligible])
-    state.inactivity_scores[:] = scores.tolist()
+    arrays.store_inactivity_scores(state, scores)
 
 
 def attestation_flag_deltas(
@@ -549,7 +660,7 @@ def attestation_flag_deltas(
         flag_penalties.append(penalty)
 
     # Inactivity penalties (get_inactivity_penalty_deltas)
-    scores = np.fromiter(state.inactivity_scores, dtype=np.uint64, count=arrays.n)
+    scores = arrays.load_inactivity_scores(state)
     participating_target = (
         arrays.unslashed_participating(TIMELY_TARGET_FLAG_INDEX, True) & prev_active
     )
@@ -606,16 +717,21 @@ def process_rewards_and_penalties_altair(
         rewards += reward
         penalties += penalty
 
-    balances = np.fromiter(state.balances, dtype=np.uint64, count=arrays.n)
+    balances = arrays.load_balances(state)
     balances += rewards
     balances = np.maximum(balances, penalties) - penalties  # saturating sub
-    state.balances[:] = balances.tolist()
+    arrays.store_balances(state, balances)
 
 
 def process_slashings_altair(state, E, fork: ForkName, arrays: EpochArrays | None = None):
+    """Correlated slashing penalties as one bulk balance writeback: the
+    (few) matched validators' penalties are computed exactly in Python
+    ints (eb//inc · adjusted overflows u64 at electra's 2048-ETH maxeb),
+    then applied as a single saturating-sub column store instead of one
+    `decrease_balance` list write per index."""
     arrays = arrays or EpochArrays(state, E)
     epoch = get_current_epoch(state, E)
-    total_balance = get_total_active_balance(state, E)
+    total_balance = arrays.total_active_balance(epoch, E)
     multiplier = (
         E.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
         if fork >= ForkName.BELLATRIX
@@ -627,18 +743,24 @@ def process_slashings_altair(state, E, fork: ForkName, arrays: EpochArrays | Non
     if not mask.any():
         return
     increment = E.EFFECTIVE_BALANCE_INCREMENT
+    penalties = np.zeros(arrays.n, dtype=np.uint64)
     if fork >= ForkName.ELECTRA:
         # EIP-7251: per-increment penalty to stay exact at 2048-ETH maxeb
+        # per_increment ≤ increment (adjusted ≤ total), eb//increment ≤
+        # 2048 at electra maxeb: the product stays far below 2**64
         per_increment = adjusted // (total_balance // increment)
         for index in np.nonzero(mask)[0]:
             eb = int(arrays.effective_balance[index])
-            decrease_balance(state, int(index), per_increment * (eb // increment))
-        return
-    for index in np.nonzero(mask)[0]:
-        eb = int(arrays.effective_balance[index])
-        penalty_numerator = eb // increment * adjusted
-        penalty = penalty_numerator // total_balance * increment
-        decrease_balance(state, int(index), penalty)
+            penalties[index] = per_increment * (eb // increment)
+    else:
+        for index in np.nonzero(mask)[0]:
+            eb = int(arrays.effective_balance[index])
+            penalty_numerator = eb // increment * adjusted
+            penalties[index] = penalty_numerator // total_balance * increment
+    balances = arrays.load_balances(state)
+    arrays.store_balances(
+        state, np.maximum(balances, penalties) - penalties
+    )
 
 
 def process_participation_flag_updates(state, E):
@@ -695,7 +817,7 @@ def _device_sweep_applicable(state, arrays: EpochArrays, spec, E) -> bool:
 
     if get_current_epoch(state, E) == GENESIS_EPOCH:
         return False
-    scores_max = max(state.inactivity_scores, default=0)
+    scores_max = int(arrays.load_inactivity_scores(state).max(initial=0))
     eb_max = int(arrays.effective_balance.max(initial=0))
     # scores grow by at most the (spec-configurable) bias in this pass
     margin = int(spec.inactivity_score_bias)
@@ -742,10 +864,8 @@ def _device_rewards_and_inactivity(state, spec: ChainSpec, E, fork: ForkName, ar
         dtype=_np.uint64,
     )
     prev_flags = arrays.prev_participation
-    scores = _np.fromiter(
-        state.inactivity_scores, dtype=_np.uint64, count=arrays.n
-    )
-    balances = _np.fromiter(state.balances, dtype=_np.uint64, count=arrays.n)
+    scores = arrays.load_inactivity_scores(state)
+    balances = arrays.load_balances(state)
     new_balances, new_scores = epoch_sweep(
         arrays.effective_balance,
         arrays.slashed,
@@ -758,13 +878,22 @@ def _device_rewards_and_inactivity(state, spec: ChainSpec, E, fork: ForkName, ar
         scalars,
     )
     # ONE bulk device→host transfer each (per-element int() would sync
-    # once per validator)
-    state.inactivity_scores[:] = _np.asarray(new_scores).tolist()
-    state.balances[:] = _np.asarray(new_balances).tolist()
+    # once per validator); the store helpers diff against the resident
+    # columns so only changed rows hit the persistent lists
+    arrays.store_inactivity_scores(state, _np.asarray(new_scores))
+    arrays.store_balances(state, _np.asarray(new_balances))
 
 
 def process_epoch_altair(state, spec: ChainSpec, E, fork: ForkName):
-    """Altair+ epoch transition (per_epoch_processing/altair.rs:55)."""
+    """Altair+ epoch transition (per_epoch_processing/altair.rs:55).
+
+    Runs over the state-resident RegistryColumns when the registry is in
+    the persistent (tree-states) representation: zero column rebuilds in
+    steady state, all sweeps as array programs, and only vectorized-diff
+    writebacks into the lists. Plain-list states take the legacy
+    per-validator snapshot path (the retained oracle). Each stage is
+    wrapped in an ``epoch_stage_*`` span for the bench breakdown."""
+    from ..utils.tracing import span
     from .per_epoch import (
         process_effective_balance_updates,
         process_eth1_data_reset,
@@ -773,40 +902,55 @@ def process_epoch_altair(state, spec: ChainSpec, E, fork: ForkName):
         process_registry_updates,
         process_slashings_reset,
     )
+    from .registry_columns import registry_columns_for
 
-    arrays = EpochArrays(state, E)
-    process_justification_and_finalization_altair(state, E, arrays)
+    columns = registry_columns_for(state)
+    if columns is not None:
+        with span("epoch_stage_columns_refresh"):
+            columns.refresh(state)
+    arrays = EpochArrays(state, E, columns=columns)
+    with span("epoch_stage_justification"):
+        process_justification_and_finalization_altair(state, E, arrays)
     if _device_sweep_enabled() and _device_sweep_applicable(
         state, arrays, spec, E
     ):
-        _device_rewards_and_inactivity(state, spec, E, fork, arrays)
+        with span("epoch_stage_rewards"):
+            _device_rewards_and_inactivity(state, spec, E, fork, arrays)
     else:
-        process_inactivity_updates(state, spec, E, arrays)
-        process_rewards_and_penalties_altair(state, spec, E, fork, arrays)
-    changed = process_registry_updates(state, spec, E, arrays=arrays)
-    # one shared snapshot per epoch: registry updates report the touched
-    # rows and the columns refresh in place (no second full rebuild)
-    arrays.refresh_rows(state, changed)
-    process_slashings_altair(state, E, fork, arrays)
+        with span("epoch_stage_inactivity"):
+            process_inactivity_updates(state, spec, E, arrays)
+        with span("epoch_stage_rewards"):
+            process_rewards_and_penalties_altair(state, spec, E, fork, arrays)
+    with span("epoch_stage_registry_updates"):
+        changed = process_registry_updates(state, spec, E, arrays=arrays)
+        # one shared view per epoch: registry updates report the touched
+        # rows and the columns re-sync in place (no second full rebuild)
+        arrays.refresh_rows(state, changed)
+    with span("epoch_stage_slashings"):
+        process_slashings_altair(state, E, fork, arrays)
     process_eth1_data_reset(state, E)
-    if fork >= ForkName.ELECTRA:
-        from .electra import (
-            process_effective_balance_updates_electra,
-            process_pending_balance_deposits,
-            process_pending_consolidations,
-        )
+    with span("epoch_stage_effective_balances"):
+        if fork >= ForkName.ELECTRA:
+            from .electra import (
+                process_effective_balance_updates_electra,
+                process_pending_balance_deposits,
+                process_pending_consolidations,
+            )
 
-        process_pending_balance_deposits(state, spec, E)
-        process_pending_consolidations(state, spec, E)
-        process_effective_balance_updates_electra(state, spec, E)
-    else:
-        process_effective_balance_updates(state, E, arrays=arrays)
-    process_slashings_reset(state, E)
-    process_randao_mixes_reset(state, E)
-    if fork >= ForkName.CAPELLA:
-        process_historical_summaries_update(state, E)
-    else:
-        process_historical_roots_update(state, E)
-    process_participation_flag_updates(state, E)
-    process_sync_committee_updates(state, E)
+            process_pending_balance_deposits(state, spec, E)
+            process_pending_consolidations(state, spec, E)
+            process_effective_balance_updates_electra(
+                state, spec, E, arrays=arrays
+            )
+        else:
+            process_effective_balance_updates(state, E, arrays=arrays)
+    with span("epoch_stage_final_updates"):
+        process_slashings_reset(state, E)
+        process_randao_mixes_reset(state, E)
+        if fork >= ForkName.CAPELLA:
+            process_historical_summaries_update(state, E)
+        else:
+            process_historical_roots_update(state, E)
+        process_participation_flag_updates(state, E)
+        process_sync_committee_updates(state, E)
     invalidate_caches(state)

@@ -449,10 +449,70 @@ def process_pending_consolidations(state, spec: ChainSpec, E):
     state.pending_consolidations = state.pending_consolidations[next_index:]
 
 
-def process_effective_balance_updates_electra(state, spec: ChainSpec, E):
+def process_effective_balance_updates_electra(state, spec: ChainSpec, E, arrays=None):
+    """EIP-7251 hysteresis sweep, vectorized: stale detection is one
+    masked pass over the resident columns (compounding-aware max-eb from
+    the withdrawal-credential prefix byte); only the out-of-band
+    validators (a handful per epoch in steady state) get object
+    writebacks, drained as one dirty-index batch by the next columns
+    refresh. The per-validator loop is retained for plain-list states."""
+    import numpy as np
+
     hysteresis_increment = E.EFFECTIVE_BALANCE_INCREMENT // E.HYSTERESIS_QUOTIENT
     down = hysteresis_increment * E.HYSTERESIS_DOWNWARD_MULTIPLIER
     up = hysteresis_increment * E.HYSTERESIS_UPWARD_MULTIPLIER
+    if arrays is not None:
+        balances = arrays.load_balances(state)
+        effective = arrays.effective_balance
+        if arrays.columns is not None:
+            compounding = (
+                arrays.columns.withdrawal_credentials[:, 0]
+                == spec.compounding_withdrawal_prefix_byte
+            )
+        else:
+            compounding = np.fromiter(
+                (
+                    has_compounding_withdrawal_credential(v, spec)
+                    for v in state.validators
+                ),
+                dtype=bool,
+                count=arrays.n,
+            )
+        max_eb = np.where(
+            compounding,
+            np.uint64(spec.max_effective_balance_electra),
+            np.uint64(spec.min_activation_balance),
+        )
+        stale = (balances + np.uint64(down) < effective) | (
+            effective + np.uint64(up) < balances
+        )
+        if not stale.any():
+            return
+        increment = np.uint64(E.EFFECTIVE_BALANCE_INCREMENT)
+        new_eff = np.minimum(balances - balances % increment, max_eb)
+        stale_idx = np.nonzero(stale)[0]
+        vs = state.validators
+        if hasattr(vs, "set_fields_bulk"):
+            from ..metrics import inc_counter
+
+            vs.set_fields_bulk(
+                stale_idx.tolist(),
+                "effective_balance",
+                new_eff[stale_idx].tolist(),
+            )
+            inc_counter(
+                "registry_columns_row_writebacks_total",
+                int(stale_idx.size),
+                field="validators",
+            )
+        else:
+            for i in stale_idx:
+                mutable_validator(state, int(i)).effective_balance = int(
+                    new_eff[i]
+                )
+        if arrays.columns is None:
+            arrays.effective_balance[stale_idx] = new_eff[stale_idx]
+        return
     for index, v in enumerate(state.validators):
         balance = state.balances[index]
         max_eb = get_validator_max_effective_balance(v, spec)

@@ -53,17 +53,35 @@ def process_epoch(state, spec: ChainSpec, E):
 
 
 def process_epoch_phase0(state, spec: ChainSpec, E):
-    """Phase0 epoch transition (runs at the last slot of each epoch)."""
-    process_justification_and_finalization(state, E)
-    process_rewards_and_penalties(state, spec, E)
-    process_registry_updates(state, spec, E)
-    process_slashings(state, E)
+    """Phase0 epoch transition (runs at the last slot of each epoch),
+    sharing the altair path's resident-columns machinery: one column
+    view for every sweep, bulk diffed writebacks, per-stage spans."""
+    from ..utils.tracing import span
+    from .altair import EpochArrays
+    from .registry_columns import registry_columns_for
+
+    columns = registry_columns_for(state)
+    if columns is not None:
+        with span("epoch_stage_columns_refresh"):
+            columns.refresh(state)
+    arrays = EpochArrays(state, E, columns=columns)
+    with span("epoch_stage_justification"):
+        process_justification_and_finalization(state, E)
+    with span("epoch_stage_rewards"):
+        process_rewards_and_penalties(state, spec, E, arrays=arrays)
+    with span("epoch_stage_registry_updates"):
+        changed = process_registry_updates(state, spec, E, arrays=arrays)
+        arrays.refresh_rows(state, changed)
+    with span("epoch_stage_slashings"):
+        process_slashings(state, E, arrays=arrays)
     process_eth1_data_reset(state, E)
-    process_effective_balance_updates(state, E)
-    process_slashings_reset(state, E)
-    process_randao_mixes_reset(state, E)
-    process_historical_roots_update(state, E)
-    process_participation_record_updates(state, E)
+    with span("epoch_stage_effective_balances"):
+        process_effective_balance_updates(state, E, arrays=arrays)
+    with span("epoch_stage_final_updates"):
+        process_slashings_reset(state, E)
+        process_randao_mixes_reset(state, E)
+        process_historical_roots_update(state, E)
+        process_participation_record_updates(state, E)
     invalidate_caches(state)
 
 
@@ -253,8 +271,10 @@ def _attestation_component_deltas(
     return rewards, penalties
 
 
-def get_attestation_deltas(state, E):
-    """Returns (rewards, penalties) arrays — phase0 get_attestation_deltas."""
+def get_attestation_deltas_reference(state, E):
+    """Per-validator Python loop deltas — the retained phase0 oracle the
+    vectorized `get_attestation_deltas` is differentially tested against
+    (tests/test_registry_columns.py)."""
     n = len(state.validators)
     total_balance = get_total_active_balance(state, E)
     eligible = get_eligible_validator_indices(state, E)
@@ -315,13 +335,149 @@ def get_attestation_deltas(state, E):
     return rewards, penalties
 
 
-def process_rewards_and_penalties(state, spec: ChainSpec, E):
+# u64-exactness of the vectorized phase0 math: eff ≤ 2**35 (32 ETH) and
+# total_balance ≥ one increment (2**30, isqrt ≥ 2**15), so base =
+# eff·64/isqrt/4 < 2**25; attesting/total increment ratios are < 2**26
+# even at 10M validators ⇒ every product below stays under 2**51. The
+# one escape is the leak's eff·finality_delay term, which gets a bigint
+# fallback when a pathological delay could overflow.
+
+
+def get_attestation_deltas(state, E, arrays=None):
+    """Returns (rewards, penalties) uint64 arrays — phase0
+    get_attestation_deltas as whole-registry masked array ops (mirroring
+    the altair flag-delta path). Attestation-driven parts (per-attester
+    inclusion-delay micro rewards) stay index loops — they are bounded by
+    committee sizes, not the registry."""
+    import numpy as np
+
+    from .altair import EpochArrays
+
+    n = len(state.validators)
+    if arrays is None:
+        arrays = EpochArrays(state, E)
+    previous = get_previous_epoch(state, E)
+    current = get_current_epoch(state, E)
+    total_balance = arrays.total_active_balance(current, E)
+
+    eff = arrays.effective_balance
+    prev_active = arrays.active_at(previous)
+    eligible = prev_active | (
+        arrays.slashed & (np.uint64(previous + 1) < arrays.withdrawable_epoch)
+    )
+    base = (
+        eff
+        * np.uint64(E.BASE_REWARD_FACTOR)
+        // np.uint64(int_sqrt(total_balance))
+        // np.uint64(BASE_REWARDS_PER_EPOCH)
+    )
+    proposer_r = base // np.uint64(E.PROPOSER_REWARD_QUOTIENT)
+
+    source_atts = get_matching_source_attestations(state, previous, E)
+    target_atts = get_matching_target_attestations(state, previous, E)
+    head_atts = get_matching_head_attestations(state, previous, E)
+    indices_cache = {
+        id(a): get_attesting_indices(state, a.data, a.aggregation_bits, E)
+        for a in source_atts
+    }
+
+    rewards = np.zeros(n, dtype=np.uint64)
+    penalties = np.zeros(n, dtype=np.uint64)
+    increment = E.EFFECTIVE_BALANCE_INCREMENT
+    leak = is_in_inactivity_leak(state, E)
+    total_increments = np.uint64(total_balance // increment)
+
+    for atts in (source_atts, target_atts, head_atts):
+        unslashed = get_unslashed_attesting_indices(
+            state, atts, E, indices_cache
+        )
+        umask = np.zeros(n, dtype=bool)
+        if unslashed:
+            umask[np.fromiter(unslashed, dtype=np.int64)] = True
+        attesting_balance = max(
+            int(eff[umask].sum(dtype=np.uint64)), increment
+        )
+        got = eligible & umask
+        if leak:
+            rewards[got] += base[got]
+        else:
+            rewards[got] += (
+                base[got] * np.uint64(attesting_balance // increment)
+                // total_increments
+            )
+        missed = eligible & ~umask
+        penalties[missed] += base[missed]
+
+    # Inclusion delay (proposer + timely-inclusion micro rewards):
+    # attestation-driven, so per-attester index updates into the arrays
+    for index in get_unslashed_attesting_indices(
+        state, source_atts, E, indices_cache
+    ):
+        candidates = [a for a in source_atts if index in indices_cache[id(a)]]
+        attestation = min(candidates, key=lambda a: a.inclusion_delay)
+        proposer_reward = int(proposer_r[index])
+        rewards[attestation.proposer_index] += np.uint64(proposer_reward)
+        max_attester_reward = int(base[index]) - proposer_reward
+        rewards[index] += np.uint64(
+            max_attester_reward // attestation.inclusion_delay
+        )
+
+    # Inactivity leak penalties
+    if leak:
+        target_attesters = get_unslashed_attesting_indices(
+            state, target_atts, E, indices_cache
+        )
+        tmask = np.zeros(n, dtype=bool)
+        if target_attesters:
+            tmask[np.fromiter(target_attesters, dtype=np.int64)] = True
+        finality_delay = get_finality_delay(state, E)
+        penalties[eligible] += (
+            np.uint64(BASE_REWARDS_PER_EPOCH) * base[eligible]
+            - proposer_r[eligible]
+        )
+        inactive = eligible & ~tmask
+        eb_max = int(eff.max(initial=0))
+        if eb_max and finality_delay > (1 << 64) // eb_max:
+            # pathological non-finality: exact bigint math per lane
+            for i in np.nonzero(inactive)[0]:
+                penalties[i] += np.uint64(
+                    int(eff[i]) * finality_delay // E.INACTIVITY_PENALTY_QUOTIENT
+                )
+        else:
+            penalties[inactive] += (
+                eff[inactive] * np.uint64(finality_delay)
+                // np.uint64(E.INACTIVITY_PENALTY_QUOTIENT)
+            )
+    return rewards, penalties
+
+
+def process_rewards_and_penalties_reference(state, spec: ChainSpec, E):
+    """The retained per-validator apply loop (oracle)."""
     if get_current_epoch(state, E) == GENESIS_EPOCH:
         return
-    rewards, penalties = get_attestation_deltas(state, E)
+    rewards, penalties = get_attestation_deltas_reference(state, E)
     for i in range(len(state.validators)):
         increase_balance(state, i, rewards[i])
         decrease_balance(state, i, penalties[i])
+
+
+def process_rewards_and_penalties(state, spec: ChainSpec, E, arrays=None):
+    """Phase0 rewards/penalties as fused saturating array ops over the
+    resident columns (mirroring the altair balance math): one vectorized
+    delta computation, one bulk diffed writeback."""
+    import numpy as np
+
+    from .altair import EpochArrays
+
+    if get_current_epoch(state, E) == GENESIS_EPOCH:
+        return
+    if arrays is None:
+        arrays = EpochArrays(state, E)
+    rewards, penalties = get_attestation_deltas(state, E, arrays=arrays)
+    balances = arrays.load_balances(state)
+    balances += rewards
+    balances = np.maximum(balances, penalties) - penalties  # saturating sub
+    arrays.store_balances(state, balances)
 
 
 # ---------------------------------------------------------------------------
@@ -347,8 +503,10 @@ def process_registry_updates(state, spec: ChainSpec, E, arrays=None):
     n = len(vs)
 
     if arrays is not None:
-        eligibility = np.fromiter(
-            (v.activation_eligibility_epoch for v in vs), dtype=np.uint64, count=n
+        # a mutable copy: the queue logic updates it in place below, and
+        # the resident column may be CoW-shared with state copies
+        eligibility = np.array(
+            arrays.activation_eligibility_epoch, dtype=np.uint64, copy=True
         )
         effective = arrays.effective_balance
         activation = arrays.activation_epoch
@@ -378,12 +536,29 @@ def process_registry_updates(state, spec: ChainSpec, E, arrays=None):
         new_eligible = (eligibility == far) & (
             effective == np.uint64(E.MAX_EFFECTIVE_BALANCE)
         )
-    for i in np.nonzero(new_eligible)[0]:
-        mutable_validator(state, int(i)).activation_eligibility_epoch = (
-            current + 1
-        )
-        eligibility[i] = current + 1
-        changed.add(int(i))
+    from ..metrics import inc_counter
+
+    bulk = getattr(vs, "set_fields_bulk", None)
+    eligible_idx = np.nonzero(new_eligible)[0]
+    if eligible_idx.size:
+        if bulk is not None:
+            bulk(
+                eligible_idx.tolist(),
+                "activation_eligibility_epoch",
+                [current + 1] * int(eligible_idx.size),
+            )
+            inc_counter(
+                "registry_columns_row_writebacks_total",
+                int(eligible_idx.size),
+                field="validators",
+            )
+        else:
+            for i in eligible_idx:
+                mutable_validator(state, int(i)).activation_eligibility_epoch = (
+                    current + 1
+                )
+        eligibility[eligible_idx] = current + 1
+        changed.update(int(i) for i in eligible_idx)
 
     # ejections (active + effective balance at/below the floor)
     active_mask = (activation <= cur) & (cur < exit_ep)
@@ -407,13 +582,28 @@ def process_registry_updates(state, spec: ChainSpec, E, arrays=None):
         active_count = int(active_mask.sum())
         limit = spec.activation_churn_limit(active_count, fork)
     target = compute_activation_exit_epoch(current, E)
-    for i in activation_queue[:limit]:
-        mutable_validator(state, int(i)).activation_epoch = target
-        changed.add(int(i))
+    admitted = activation_queue[:limit]
+    if len(admitted):
+        if bulk is not None:
+            bulk(
+                [int(i) for i in admitted],
+                "activation_epoch",
+                [target] * len(admitted),
+            )
+            inc_counter(
+                "registry_columns_row_writebacks_total",
+                len(admitted),
+                field="validators",
+            )
+        else:
+            for i in admitted:
+                mutable_validator(state, int(i)).activation_epoch = target
+        changed.update(int(i) for i in admitted)
     return sorted(changed)
 
 
-def process_slashings(state, E):
+def process_slashings_reference(state, E):
+    """The retained per-validator slashing sweep (oracle)."""
     epoch = get_current_epoch(state, E)
     total_balance = get_total_active_balance(state, E)
     adjusted = min(
@@ -428,6 +618,37 @@ def process_slashings(state, E):
             decrease_balance(state, index, penalty)
 
 
+def process_slashings(state, E, arrays=None):
+    """Phase0 correlated slashings: the matched set comes from one column
+    mask; the (few) penalties are computed exactly in Python ints and
+    applied as a single saturating-sub bulk writeback (mirroring the
+    altair path)."""
+    import numpy as np
+
+    from .altair import EpochArrays
+
+    if arrays is None:
+        arrays = EpochArrays(state, E)
+    epoch = get_current_epoch(state, E)
+    total_balance = arrays.total_active_balance(epoch, E)
+    adjusted = min(
+        sum(state.slashings) * E.PROPORTIONAL_SLASHING_MULTIPLIER, total_balance
+    )
+    target_epoch = np.uint64(epoch + E.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+    mask = arrays.slashed & (arrays.withdrawable_epoch == target_epoch)
+    if not mask.any():
+        return
+    increment = E.EFFECTIVE_BALANCE_INCREMENT
+    penalties = np.zeros(arrays.n, dtype=np.uint64)
+    for index in np.nonzero(mask)[0]:
+        eb = int(arrays.effective_balance[index])
+        penalties[index] = eb // increment * adjusted // total_balance * increment
+    balances = arrays.load_balances(state)
+    arrays.store_balances(
+        state, np.maximum(balances, penalties) - penalties
+    )
+
+
 def process_eth1_data_reset(state, E):
     next_epoch = get_current_epoch(state, E) + 1
     if next_epoch % E.EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
@@ -436,14 +657,16 @@ def process_eth1_data_reset(state, E):
 
 def process_effective_balance_updates(state, E, arrays=None):
     """Hysteresis sweep as one vectorized pass; only out-of-band validators
-    (a handful per epoch in steady state) get object writebacks."""
+    (a handful per epoch in steady state) get object writebacks — drained
+    as one dirty-index batch by the next columns refresh."""
     import numpy as np
 
     n = len(state.validators)
-    balances = np.asarray(state.balances, dtype=np.uint64)
     if arrays is not None:
+        balances = arrays.load_balances(state)
         effective = arrays.effective_balance
     else:
+        balances = np.asarray(state.balances, dtype=np.uint64)
         effective = np.fromiter(
             (v.effective_balance for v in state.validators),
             dtype=np.uint64,
@@ -459,10 +682,29 @@ def process_effective_balance_updates(state, E, arrays=None):
     new_eff = np.minimum(
         balances - balances % increment, np.uint64(E.MAX_EFFECTIVE_BALANCE)
     )
-    for i in np.nonzero(stale)[0]:
-        mutable_validator(state, int(i)).effective_balance = int(new_eff[i])
-        if arrays is not None:
-            arrays.effective_balance[i] = new_eff[i]
+    stale_idx = np.nonzero(stale)[0]
+    vs = state.validators
+    if hasattr(vs, "set_fields_bulk"):
+        from ..metrics import inc_counter
+
+        # ONE bulk column store (shallow clones + a single dirty batch)
+        # instead of a mutate() deep-copy per stale validator — the next
+        # columns refresh drains the whole batch at once
+        vs.set_fields_bulk(
+            stale_idx.tolist(), "effective_balance", new_eff[stale_idx].tolist()
+        )
+        inc_counter(
+            "registry_columns_row_writebacks_total",
+            int(stale_idx.size),
+            field="validators",
+        )
+    else:
+        for i in stale_idx:
+            mutable_validator(state, int(i)).effective_balance = int(new_eff[i])
+    if arrays is not None and arrays.columns is None:
+        # legacy snapshot: update in place (resident columns re-sync
+        # from the dirty drain instead — the column may be CoW-shared)
+        arrays.effective_balance[stale_idx] = new_eff[stale_idx]
 
 
 def process_slashings_reset(state, E):

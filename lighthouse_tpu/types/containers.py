@@ -14,6 +14,7 @@ are built inside a function scope.
 import functools
 from types import SimpleNamespace
 
+from ..ssz.persistent import PersistentContainerList, PersistentList
 from ..ssz.core import (
     Bitlist,
     Bitvector,
@@ -212,6 +213,14 @@ def build_types(E: type) -> SimpleNamespace:
         # inactivity fields — subclass families inherit and extend).
         hash_tree_root = _state_hash_tree_root
         _THC_LIST_FIELDS = ("validators", "balances")
+        # registry-scale fields mirrored by the resident column store
+        # (state_processing/registry_columns): columns engage only when
+        # every listed field is in the persistent (tree-states)
+        # representation — plain-list states take the legacy epoch path
+        _REGISTRY_COLUMN_FIELDS = (
+            ("validators", PersistentContainerList),
+            ("balances", PersistentList),
+        )
 
     class AggregateAndProof(Container):
         aggregator_index: uint64
@@ -308,6 +317,11 @@ def build_types(E: type) -> SimpleNamespace:
             "previous_epoch_participation",
             "current_epoch_participation",
             "inactivity_scores",
+        )
+        _REGISTRY_COLUMN_FIELDS = (
+            ("validators", PersistentContainerList),
+            ("balances", PersistentList),
+            ("inactivity_scores", PersistentList),
         )
 
     # -- Bellatrix (execution payloads) ------------------------------------

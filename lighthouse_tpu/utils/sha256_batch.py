@@ -223,6 +223,48 @@ def sha256_batch(messages: np.ndarray) -> np.ndarray:
     return out
 
 
+def _host_mode(n: int) -> str:
+    """The host-backend decision shared by hash_rows and hash_messages:
+    env pin if set (device resolves per-caller), else hashlib under
+    _BATCH_MIN rows, else the calibrated winner."""
+    mode = os.environ.get("LIGHTHOUSE_TPU_SHA256_MODE", "auto")
+    if mode == "auto":
+        return "hashlib" if n < _BATCH_MIN else _calibrate()
+    return mode
+
+
+def hash_messages(messages: np.ndarray) -> np.ndarray:
+    """SHA-256 of n same-length messages with the hash_rows-style
+    dispatch: [n, L] uint8 → [n, 32] uint8.
+
+    Small batches take a C-speed hashlib loop (numpy lane setup costs
+    more than it saves); large batches take the calibrated winner, with
+    LIGHTHOUSE_TPU_SHA256_MODE pinning the choice. The calibration
+    measures the 64-byte two-to-one shape — a close proxy for any
+    message under two compression blocks (the swap-or-not shuffle's
+    37-byte round messages, the main consumer here).
+    """
+    messages = np.atleast_2d(np.asarray(messages, dtype=np.uint8))
+    n, length = messages.shape
+    if n == 0:
+        return np.zeros((0, 32), dtype=np.uint8)
+    mode = _host_mode(n)
+    if mode == "device":
+        # no general-length device kernel: take the calibrated host winner
+        mode = "hashlib" if n < _BATCH_MIN else _calibrate()
+    if mode == "numpy":
+        return sha256_batch(messages)
+    data = messages.tobytes()
+    out = bytearray(n * 32)
+    mv = memoryview(data)
+    sha = hashlib.sha256
+    for i in range(n):
+        out[i * 32 : (i + 1) * 32] = sha(
+            mv[i * length : (i + 1) * length]
+        ).digest()
+    return np.frombuffer(out, dtype=np.uint8).reshape(n, 32)
+
+
 def hash_rows_hashlib(pairs: np.ndarray) -> np.ndarray:
     """[n, 64] uint8 → [n, 32] uint8 via one C-speed hashlib pass over a
     contiguous buffer (no per-row numpy objects)."""
@@ -284,9 +326,7 @@ def hash_rows(pairs: np.ndarray) -> np.ndarray:
     n = pairs.shape[0]
     if n == 0:
         return np.zeros((0, 32), dtype=np.uint8)
-    mode = os.environ.get("LIGHTHOUSE_TPU_SHA256_MODE", "auto")
-    if mode == "auto":
-        mode = "hashlib" if n < _BATCH_MIN else _calibrate()
+    mode = _host_mode(n)
     if mode == "numpy":
         return hash_rows_numpy(pairs)
     if mode == "device":

@@ -32,6 +32,9 @@ def pytest_sessionstart(session):
     from lighthouse_tpu.crypto import bls  # noqa: F401 — registers counters
     from lighthouse_tpu.metrics import REGISTRY
     from lighthouse_tpu.network import sync  # noqa: F401 — registers sync series
+    from lighthouse_tpu.state_processing import (  # noqa: F401 — registers
+        registry_columns,  # the columns counters + epoch_stage spans
+    )
 
     text = REGISTRY.expose()
     for needle in (
@@ -57,6 +60,23 @@ def pytest_sessionstart(session):
         "sync_lookups_completed_total",
         "sync_lookups_failed_total",
         "sync_lookup_reprocess_drained_total",
+        # PR 6: the resident-columns counters and the per-stage epoch
+        # spans must exist at zero — the epoch_transition benches and
+        # the perf_smoke zero-rebuild guard read them eagerly
+        'registry_columns_rebuilds_total{field="validators"}',
+        'registry_columns_rebuilds_total{field="balances"}',
+        'registry_columns_rebuilds_total{field="inactivity_scores"}',
+        'registry_columns_row_writebacks_total{field="validators"}',
+        'registry_columns_row_writebacks_total{field="balances"}',
+        'registry_columns_row_writebacks_total{field="inactivity_scores"}',
+        "trace_span_seconds_epoch_stage_columns_refresh",
+        "trace_span_seconds_epoch_stage_justification",
+        "trace_span_seconds_epoch_stage_inactivity",
+        "trace_span_seconds_epoch_stage_rewards",
+        "trace_span_seconds_epoch_stage_registry_updates",
+        "trace_span_seconds_epoch_stage_slashings",
+        "trace_span_seconds_epoch_stage_effective_balances",
+        "trace_span_seconds_epoch_stage_final_updates",
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"
