@@ -851,6 +851,158 @@ def bench_epoch_transition_1m(jax):
     )
 
 
+def _build_attestation_block(n: int, atts_per_committee: int):
+    """A mainnet-shaped attestation batch: an altair tree-states state of
+    `n` cloned validators (committee size ≈ mainnet's ~450 at 1M) plus a
+    block's worth of valid previous-epoch attestations — every
+    (slot, committee) pair of the previous epoch × `atts_per_committee`
+    random aggregation patterns (the duplicate-attester fold is
+    exercised, exactly like real aggregates)."""
+    import random as _r
+
+    from lighthouse_tpu.state_processing.accessors import (
+        committee_cache_at,
+        get_previous_epoch,
+    )
+    from lighthouse_tpu.types.containers import build_types
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    state, spec, _ = _build_epoch_state(n, resident=True)
+    state.slot = int(state.slot) + 1  # epoch start: all delays includable
+    t = build_types(E)
+    rng = _r.Random(11)
+    prev = get_previous_epoch(state, E)
+    cc = committee_cache_at(state, prev, E)
+    atts = []
+    for slot in range(prev * E.SLOTS_PER_EPOCH, (prev + 1) * E.SLOTS_PER_EPOCH):
+        for index in range(cc.committees_per_slot):
+            committee = cc.committee_array(slot, index)
+            for _ in range(atts_per_committee):
+                bits = [rng.random() < 0.7 for _ in range(committee.size)]
+                if not any(bits):
+                    bits[0] = True
+                atts.append(
+                    t.Attestation(
+                        aggregation_bits=bits,
+                        data=t.AttestationData(
+                            slot=slot,
+                            index=index,
+                            beacon_block_root=state.block_roots[
+                                slot % E.SLOTS_PER_HISTORICAL_ROOT
+                            ],
+                            source=state.previous_justified_checkpoint,
+                            target=t.Checkpoint(
+                                epoch=prev,
+                                root=state.block_roots[
+                                    (prev * E.SLOTS_PER_EPOCH)
+                                    % E.SLOTS_PER_HISTORICAL_ROOT
+                                ],
+                            ),
+                        ),
+                        signature=b"\x00" * 96,
+                    )
+                )
+    return state, spec, atts
+
+
+def bench_attestation_batch(jax):
+    """The block-import hot path PRs 3-6 never touched: apply a block's
+    worth of attestations (participation scatter + proposer rewards).
+    Columnar pipeline (attestation_batch.process_attestations) vs the
+    retained scalar oracle (process_attestations_reference), same
+    attestations, fresh state copies, same run."""
+    import gc
+
+    from lighthouse_tpu.metrics import REGISTRY
+    from lighthouse_tpu.state_processing.attestation_batch import (
+        process_attestations,
+        process_attestations_reference,
+    )
+    from lighthouse_tpu.state_processing.per_block import ConsensusContext
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    n = 2_000 if SMOKE else 16_384  # committee ≈ 512 ≈ mainnet shape
+    per_committee = 1 if SMOKE else 4  # 8 slots × 4 committees × 4 = 128
+    state, spec, atts = _build_attestation_block(n, per_committee)
+    from lighthouse_tpu.state_processing.accessors import (
+        committee_cache_at,
+        get_previous_epoch,
+    )
+    from lighthouse_tpu.types.containers import build_types
+
+    fork = build_types(E).fork_of_state(state)
+    proposer = 0
+
+    def fresh_ctxt():
+        ctxt = ConsensusContext(state.slot)
+        ctxt.set_proposer_index(proposer)
+        return ctxt
+
+    def warm(s):
+        # a node imports blocks against states whose epoch shuffling is
+        # already cached; pre-build it (both paths get the same warmup)
+        committee_cache_at(s, get_previous_epoch(s, E), E)
+        return s
+
+    trials = 3
+    copies = [warm(state.copy()) for _ in range(trials + 1)]
+
+    def run():
+        process_attestations(
+            copies.pop(), atts, spec, E, False, fresh_ctxt(), fork
+        )
+
+    before = REGISTRY.counter("attestation_batch_total").values().copy()
+    spans_before = _span_totals(("attestation_apply",))
+    t = _trials(run, n=trials, between=gc.collect)
+    stages = _span_deltas(spans_before, _span_totals(("attestation_apply",)))
+    after = REGISTRY.counter("attestation_batch_total").values()
+
+    # differential check rides the bench: batched and scalar end states
+    # must agree bit-for-bit on participation and balances
+    batched = copies.pop()
+    process_attestations(batched, atts, spec, E, False, fresh_ctxt(), fork)
+    oracle = warm(state.copy())
+    ctrl_times = []
+    for i in range(2):
+        ctrl_state = oracle if i == 0 else warm(state.copy())
+        t0 = time.perf_counter()
+        process_attestations_reference(
+            ctrl_state, atts, spec, E, False, fresh_ctxt(), fork
+        )
+        ctrl_times.append(time.perf_counter() - t0)
+        _partial(control_trial=i + 1, of=2, s=round(ctrl_times[-1], 4))
+    assert bytes(batched.previous_epoch_participation) == bytes(
+        oracle.previous_epoch_participation
+    ), "batched vs scalar participation mismatch"
+    assert list(batched.balances) == list(oracle.balances), (
+        "batched vs scalar proposer reward mismatch"
+    )
+
+    ctrl = statistics.median(ctrl_times)
+    return {
+        "metric": "attestation_batch_ms",
+        "value": round(t["median_s"] * 1000, 2),
+        "unit": f"ms/block ({len(atts)} attestations, {n} validators)",
+        "vs_baseline": round(ctrl / t["median_s"], 2),
+        "baseline_control": (
+            "retained scalar loop (process_attestations_reference), same "
+            "attestations + fresh state copies, same run"
+        ),
+        "config": {
+            "validators": n,
+            "attestations": len(atts),
+            "scalar_ms": round(ctrl * 1000, 2),
+            "differential_check": "passed",
+            "path_counters": {
+                k[0][1]: v - before.get(k, 0) for k, v in after.items()
+            },
+        },
+        "stages": stages,
+        "spread": t,
+    }
+
+
 def bench_sync_catchup(jax):
     """Sync-engine catch-up rate: blocks/sec for a fresh node pulling N
     slots from a loopback peer through the batch state machine
@@ -943,6 +1095,7 @@ _METRICS = {
     "kzg": bench_kzg,
     "bls": bench_bls,
     "sync_catchup": bench_sync_catchup,
+    "attestation_batch": bench_attestation_batch,
 }
 
 
@@ -1066,6 +1219,10 @@ def main():
         "epoch_reroot": 300,  # 1M mass-churn full-rebuild re-roots
         "kzg": 240,  # metric 4; compile served by the warmed cache
         "sync_catchup": 120,  # fake_crypto loopback pair; no compiles
+        # 16k-validator fixture + 3 columnar trials + 2 scalar-oracle
+        # controls (the controls dominate: ~65k per-validator Python
+        # iterations each)
+        "attestation_batch": 120,
     }
     for name, cap in secondary_caps.items():
         cap = _metric_cap(name, cap)

@@ -1068,12 +1068,23 @@ class BeaconChain:
 def _make_persistent(state):
     """Swap registry-scale list fields to persistent (structurally-shared)
     lists in place — the tree-states backbone (beacon_state.rs:34,371)."""
-    from ..ssz.persistent import PersistentContainerList, PersistentList
+    from ..ssz.persistent import (
+        PersistentByteList,
+        PersistentContainerList,
+        PersistentList,
+    )
 
     for fname in ("balances", "inactivity_scores"):
         v = getattr(state, fname, None)
         if isinstance(v, list):
             object.__setattr__(state, fname, PersistentList(v))
+    for fname in (
+        "previous_epoch_participation",
+        "current_epoch_participation",
+    ):
+        v = getattr(state, fname, None)
+        if isinstance(v, bytearray):
+            object.__setattr__(state, fname, PersistentByteList(v))
     v = getattr(state, "validators", None)
     if isinstance(v, list):
         object.__setattr__(state, "validators", PersistentContainerList(v))

@@ -133,28 +133,36 @@ class OperationPool:
                 if source_ok:
                     candidates.append(att)
 
-        # (data_root, attestation) pairs — roots hashed once, not per round
-        keyed = [(att.data.hash_tree_root(), att) for att in candidates]
-        chosen: list = []
-        covered: set[tuple[bytes, int]] = set()
-        while keyed and len(chosen) < E.MAX_ATTESTATIONS:
-            def gain(item):
-                dr, att = item
-                return sum(
-                    1
-                    for i, bit in enumerate(att.aggregation_bits)
-                    if bit and (dr, i) not in covered
-                )
+        # (data_root, attestation, bits) triples — roots hashed and bit
+        # lists decoded ONCE; per-round gains are then C-speed boolean
+        # kernels over numpy masks instead of Python per-bit set probes
+        # (the attestation pipeline's coverage-set representation)
+        import numpy as np
 
-            best = max(keyed, key=gain)
-            if gain(best) == 0:
-                break
-            keyed.remove(best)
-            dr, att = best
-            chosen.append(att)
-            covered.update(
-                (dr, i) for i, bit in enumerate(att.aggregation_bits) if bit
+        keyed = [
+            (
+                att.data.hash_tree_root(),
+                att,
+                np.asarray(att.aggregation_bits, dtype=bool),
             )
+            for att in candidates
+        ]
+        chosen: list = []
+        covered: dict[bytes, np.ndarray] = {}  # data_root -> covered mask
+        while keyed and len(chosen) < E.MAX_ATTESTATIONS:
+            gains = [
+                int(bits.sum())
+                if (cov := covered.get(dr)) is None
+                else int(np.count_nonzero(bits & ~cov))
+                for dr, _, bits in keyed
+            ]
+            best_i = max(range(len(keyed)), key=gains.__getitem__)
+            if gains[best_i] == 0:
+                break
+            dr, att, bits = keyed.pop(best_i)
+            chosen.append(att)
+            cov = covered.get(dr)
+            covered[dr] = bits.copy() if cov is None else (cov | bits)
         return chosen
 
     def get_slashings_and_exits(self, state) -> tuple[list, list, list]:

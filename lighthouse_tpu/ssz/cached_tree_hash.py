@@ -404,6 +404,32 @@ class Uint64ListCache(_TokenListCache):
         return root
 
 
+class ByteListCache(_TokenListCache):
+    """Cache for PersistentByteList-backed fields (the altair
+    participation-flag lists): element dirt maps 32-to-1 onto packed
+    chunks, so a block's worth of attestation flag writes re-roots as a
+    handful of chunk paths instead of a full 1M-byte diff."""
+
+    def root(self, value) -> bytes:
+        n = len(value)
+        n_chunks = (n + 31) // 32
+        chunk_idx = self._dirty_chunks(
+            value, n_chunks, lambda d: {e >> 5 for e in d if e < n}
+        )
+        if chunk_idx is None:
+            _STATS["full_extracts"] += 1
+            root = self.tree.update(value.to_chunk_matrix())
+        elif not chunk_idx:
+            root = self.tree.root_only()
+        else:
+            idx = np.fromiter(sorted(chunk_idx), dtype=np.int64)
+            root = self.tree.update_rows(
+                idx, value.chunk_rows(idx), n_chunks
+            )
+        self._committed = value.dirt_token
+        return root
+
+
 class ContainerListCache(_TokenListCache):
     """Cache for a PersistentContainerList registry (validators): layer 0
     is the per-element container roots; dirty elements re-root through
@@ -515,6 +541,19 @@ class BeaconStateHashCache:
         out._caches = {k: c.copy() for k, c in self._caches.items()}
         return out
 
+    def rotate_participation(self):
+        """Epoch-boundary participation rotation (altair
+        process_participation_flag_updates): previous ← current, current
+        ← zeros. The committed tokens ride the rotated list objects, so
+        moving the per-field cache along keeps the NEXT block's
+        attestation writes on the sparse update path; the fresh current
+        field rebuilds its (all-zeros) tree on first use."""
+        cur = self._caches.pop("current_epoch_participation", None)
+        if cur is not None and type(cur) is ByteListCache:
+            self._caches["previous_epoch_participation"] = cur
+        else:
+            self._caches.pop("previous_epoch_participation", None)
+
     def _cache_for(self, fname: str, ftype, kind=TreeHashCache):
         """The per-field cache, re-created when a field's runtime
         representation changed kind (e.g. plain list → persistent after
@@ -537,7 +576,11 @@ class BeaconStateHashCache:
             from .merkle import mix_in_length
 
             value = getattr(state, fname)
-            from .persistent import PersistentContainerList, PersistentList
+            from .persistent import (
+                PersistentByteList,
+                PersistentContainerList,
+                PersistentList,
+            )
 
             if isinstance(value, PersistentContainerList):
                 cache = self._cache_for(fname, ftype, ContainerListCache)
@@ -559,6 +602,9 @@ class BeaconStateHashCache:
                 )
             if isinstance(value, PersistentList):
                 cache = self._cache_for(fname, ftype, Uint64ListCache)
+                return mix_in_length(cache.root(value), len(value))
+            if isinstance(value, PersistentByteList):
+                cache = self._cache_for(fname, ftype, ByteListCache)
                 return mix_in_length(cache.root(value), len(value))
             cache = self._cache_for(fname, ftype)
             root = cache.update(ent(state, None))

@@ -26,7 +26,7 @@ from .merkle import (
     mix_in_selector,
     pack_bytes,
 )
-from .persistent import PersistentContainerList, PersistentList
+from .persistent import PersistentByteList, PersistentContainerList, PersistentList
 
 BYTES_PER_LENGTH_OFFSET = 4
 
@@ -316,7 +316,12 @@ class ParticipationList(ByteList):
     """`List[ParticipationFlags]` (uint8) with a MUTABLE bytearray runtime
     representation: altair participation flags are updated per attesting
     index in place (process_attestation), and the epoch sweep reads them
-    zero-copy via numpy frombuffer. Wire format identical to List[uint8]."""
+    zero-copy via numpy frombuffer. Wire format identical to List[uint8].
+
+    Tree-states nodes swap the bytearray for a PersistentByteList
+    (chain._make_persistent): structurally-shared blocks with dirty-index
+    channels, so per-block participation writes reach the hash caches and
+    the resident registry columns as exact deltas."""
 
     def _make(cls, limit):
         return type(
@@ -337,7 +342,23 @@ class ParticipationList(ByteList):
         return bytearray()
 
     @classmethod
+    def hash_tree_root_of(cls, value) -> bytes:
+        if isinstance(value, PersistentByteList):
+            # structural-sharing fast path: block-memoized subtree roots
+            root = value.hash_tree_root(cls.chunk_count())
+            return mix_in_length(root, len(value))
+        return super().hash_tree_root_of(value)
+
+    @classmethod
     def coerce(cls, value):
+        if isinstance(value, PersistentByteList):
+            # already element-validated; share blocks but never alias the
+            # caller's object (no CoW barrier without the copy())
+            if len(value) > cls.LIMIT:
+                raise ValueError(
+                    f"ParticipationList: got {len(value)} bytes"
+                )
+            return value.copy()
         b = bytearray(value)
         if len(b) > cls.LIMIT:
             raise ValueError(f"ParticipationList: got {len(b)} bytes")
@@ -929,7 +950,9 @@ class Container(SSZType, metaclass=_ContainerMeta):
 def _deep_copy(ftype, value):
     if isinstance(value, Container):
         return value.copy()
-    if isinstance(value, (PersistentList, PersistentContainerList)):
+    if isinstance(
+        value, (PersistentList, PersistentContainerList, PersistentByteList)
+    ):
         return value.copy()  # O(#blocks) structural share
     if isinstance(value, bytearray):
         return bytearray(value)

@@ -465,6 +465,251 @@ def _fold_roots(roots: list[bytes], level: int, total_depth: int) -> bytes:
 
 
 # ---------------------------------------------------------------------------
+# Persistent byte list (participation flags: List[uint8] packed 32/chunk)
+# ---------------------------------------------------------------------------
+
+BYTE_BLOCK = 8192  # uint8 elements per block = 256 chunks = a depth-8 subtree
+_BYTE_CHUNKS_PER_BLOCK = BYTE_BLOCK // 32  # 256
+_BYTE_BLOCK_DEPTH = (_BYTE_CHUNKS_PER_BLOCK - 1).bit_length()  # 8
+
+
+def _fold_bytes(data: bytes, depth: int) -> bytes:
+    """Pack raw bytes into 32-byte chunks and fold to a subtree root at
+    `depth`, zero-padding absent chunks (the byte-list analog of
+    `_fold_values`)."""
+    if len(data) % 32:
+        data = bytes(data) + b"\x00" * (32 - len(data) % 32)
+    nodes = [data[i : i + 32] for i in range(0, len(data), 32)] or [
+        ZERO_HASHES[0]
+    ]
+    for level in range(depth):
+        if len(nodes) % 2:
+            nodes.append(ZERO_HASHES[level])
+        nodes = [
+            hashlib.sha256(nodes[i] + nodes[i + 1]).digest()
+            for i in range(0, len(nodes), 2)
+        ]
+    return nodes[0]
+
+
+class _BBlock:
+    __slots__ = ("items", "root")
+
+    def __init__(self, items: bytearray):
+        self.items = items
+        self.root: bytes | None = None
+
+    def subtree_root(self) -> bytes:
+        if self.root is None:
+            self.root = _fold_bytes(bytes(self.items), _BYTE_BLOCK_DEPTH)
+        return self.root
+
+
+class PersistentByteList(_DirtyTracking):
+    """Structurally-shared List[uint8] — the persistent representation of
+    the altair participation-flag lists (ssz/core.py ParticipationList).
+
+    Same contract as PersistentList (the balances backbone): O(#blocks)
+    `copy()` with copy-on-write blocks, per-block subtree-root memos,
+    per-channel dirty-index tracking (element == byte index) so BOTH the
+    tree-hash caches and the resident registry columns consume exact
+    deltas, and `load_array`/`store_array` bulk numpy interchange for the
+    vectorized attestation pipeline. Mutation surface: indexing, item
+    assignment, `append`, iteration, `len`, `bytes()`, equality against
+    any bytes-like."""
+
+    __slots__ = ("_blocks", "_owned", "_channels")
+
+    def __init__(self, values=b""):
+        data = bytearray(values)
+        self._blocks = [
+            _BBlock(data[i : i + BYTE_BLOCK])
+            for i in range(0, len(data), BYTE_BLOCK)
+        ]
+        self._owned = [True] * len(self._blocks)
+        self._init_dirt()
+
+    @staticmethod
+    def _coerce(v) -> int:
+        v = int(v)
+        if not 0 <= v <= 255:
+            raise ValueError(f"uint8 out of range: {v}")
+        return v
+
+    # -- structural sharing ---------------------------------------------
+
+    def copy(self) -> "PersistentByteList":
+        out = PersistentByteList.__new__(PersistentByteList)
+        out._blocks = list(self._blocks)
+        out._owned = [False] * len(self._blocks)
+        self._owned = [False] * len(self._blocks)
+        self._copy_dirt_to(out)  # same baseline, same pending dirt
+        return out
+
+    def _own(self, bi: int) -> _BBlock:
+        blk = self._blocks[bi]
+        if not self._owned[bi]:
+            blk = _BBlock(bytearray(blk.items))
+            self._blocks[bi] = blk
+            self._owned[bi] = True
+        blk.root = None
+        return blk
+
+    def shared_block_count(self, other: "PersistentByteList") -> int:
+        mine = {id(b) for b in self._blocks}
+        return sum(1 for b in other._blocks if id(b) in mine)
+
+    # -- list / bytes surface --------------------------------------------
+
+    def __len__(self) -> int:
+        if not self._blocks:
+            return 0
+        return (len(self._blocks) - 1) * BYTE_BLOCK + len(
+            self._blocks[-1].items
+        )
+
+    def __iter__(self):
+        for blk in self._blocks:
+            yield from blk.items
+
+    def __bytes__(self) -> bytes:
+        return b"".join(bytes(blk.items) for blk in self._blocks)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return bytes(self)[idx]
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError(idx)
+        return self._blocks[idx // BYTE_BLOCK].items[idx % BYTE_BLOCK]
+
+    def __setitem__(self, idx, value):
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError(idx)
+        v = self._coerce(value)
+        bi, off = divmod(idx, BYTE_BLOCK)
+        if self._blocks[bi].items[off] != v:
+            self._own(bi).items[off] = v
+            self._mark(idx)
+
+    def append(self, value):
+        v = self._coerce(value)
+        if self._blocks and len(self._blocks[-1].items) < BYTE_BLOCK:
+            self._own(len(self._blocks) - 1).items.append(v)
+        else:
+            self._blocks.append(_BBlock(bytearray([v])))
+            self._owned.append(True)
+        self._mark(len(self) - 1)
+
+    def __eq__(self, other):
+        if isinstance(
+            other, (PersistentByteList, bytes, bytearray, list, tuple)
+        ):
+            return len(self) == len(other) and all(
+                a == b for a, b in zip(self, other)
+            )
+        return NotImplemented
+
+    def __repr__(self):
+        n = len(self)
+        return f"PersistentByteList(len={n}, {bytes(self)[:8].hex()}…)"
+
+    # -- bulk numpy interchange (the attestation-pipeline fast path) ------
+
+    def load_array(self):
+        """The whole list as a [n] uint8 array."""
+        import numpy as np
+
+        out = np.empty(len(self), dtype=np.uint8)
+        pos = 0
+        for blk in self._blocks:
+            out[pos : pos + len(blk.items)] = np.frombuffer(
+                blk.items, dtype=np.uint8
+            )
+            pos += len(blk.items)
+        return out
+
+    def store_array(self, new, changed=None, exclude_channel=None) -> int:
+        """Bulk same-length store from a [n] uint8 array; only elements at
+        `changed` (vectorized diff when omitted) are written and
+        dirty-marked — the PersistentList.store_array contract."""
+        import numpy as np
+
+        n = len(self)
+        new = np.ascontiguousarray(new, dtype=np.uint8)
+        if new.size != n:
+            raise ValueError(f"store_array length {new.size} != {n}")
+        if changed is None:
+            changed = np.nonzero(self.load_array() != new)[0]
+        if changed.size == 0:
+            return 0
+        pos = 0
+        ci = 0
+        for bi in range(len(self._blocks)):
+            blen = len(self._blocks[bi].items)
+            hi = int(np.searchsorted(changed, pos + blen))
+            if hi > ci:
+                blk = self._own(bi)
+                span = changed[ci:hi]
+                if span.size > blen // 4:
+                    blk.items[:] = new[pos : pos + blen].tobytes()
+                else:
+                    vals = new[span].tolist()
+                    offs = (span - pos).tolist()
+                    for off, v in zip(offs, vals):
+                        blk.items[off] = v
+                ci = hi
+            pos += blen
+        self._mark_bulk(changed, exclude_channel)
+        return int(changed.size)
+
+    # -- hashing ----------------------------------------------------------
+
+    def to_chunk_matrix(self):
+        """The whole list as an SSZ leaf matrix [⌈n/32⌉, 32] uint8 (the
+        full-extraction path of the state-level caches)."""
+        import numpy as np
+
+        n = len(self)
+        n_chunks = (n + 31) // 32
+        buf = np.zeros(n_chunks * 32, dtype=np.uint8)
+        buf[:n] = self.load_array()
+        return buf.reshape(-1, 32)
+
+    def chunk_rows(self, chunk_idx):
+        """[m, 32] leaf rows for the given chunk indices (zero-padded
+        tail) — the sparse-update gather. A chunk never crosses a block
+        boundary (BYTE_BLOCK % 32 == 0)."""
+        import numpy as np
+
+        n = len(self)
+        m = len(chunk_idx)
+        rows = np.zeros((m, 32), dtype=np.uint8)
+        for r, c in enumerate(chunk_idx):
+            lo = int(c) * 32
+            span = min(32, n - lo)
+            bi, off = divmod(lo, BYTE_BLOCK)
+            rows[r, :span] = np.frombuffer(
+                self._blocks[bi].items, dtype=np.uint8, count=span, offset=off
+            )
+        return rows
+
+    def hash_tree_root(self, limit_chunks: int) -> bytes:
+        """Merkle root over the list's chunks zero-extended to
+        `limit_chunks` (no length mix — the SSZ type mixes it)."""
+        total_depth = (limit_chunks - 1).bit_length() if limit_chunks > 1 else 0
+        if total_depth < _BYTE_BLOCK_DEPTH:
+            return _fold_bytes(bytes(self), total_depth)
+        roots = [blk.subtree_root() for blk in self._blocks]
+        return _fold_roots(roots, _BYTE_BLOCK_DEPTH, total_depth)
+
+
+# ---------------------------------------------------------------------------
 # Persistent container list (the milhouse `List<Validator>` analog)
 # ---------------------------------------------------------------------------
 

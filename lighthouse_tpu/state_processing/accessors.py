@@ -193,7 +193,10 @@ class CommitteeCache:
     def committee_count(self) -> int:
         return self.committees_per_slot * self.slots_per_epoch
 
-    def committee(self, slot: int, index: int) -> list[int]:
+    def committee_array(self, slot: int, index: int):
+        """The committee as a zero-copy int64 slice of the epoch's
+        shuffled permutation — the batched attestation pipeline's gather
+        source (no Python-list materialization)."""
         if index >= self.committees_per_slot:
             raise IndexError(
                 f"committee index {index} >= {self.committees_per_slot}"
@@ -205,9 +208,12 @@ class CommitteeCache:
         count = self.committee_count
         start = n * global_index // count
         end = n * (global_index + 1) // count
+        return self.shuffled[start:end]
+
+    def committee(self, slot: int, index: int) -> list[int]:
         # plain ints out: members land in SSZ containers, dict keys and
         # signature sets — np.int64 leaking there is a foot-gun
-        return self.shuffled[start:end].tolist()
+        return self.committee_array(slot, index).tolist()
 
     def active_validator_count(self) -> int:
         return len(self.shuffled)
@@ -345,14 +351,30 @@ def get_block_root(state, epoch: int, E) -> bytes:
 # ---------------------------------------------------------------------------
 
 
-def get_attesting_indices(state, data, aggregation_bits, E) -> list[int]:
-    committee = get_beacon_committee(state, data.slot, data.index, E)
-    if len(aggregation_bits) != len(committee):
+def attesting_indices_array(state, data, aggregation_bits, E):
+    """Attesting validator indices as a SORTED int64 array: one boolean
+    gather over the committee's zero-copy permutation slice — the shared
+    columnar source for indexed-attestation assembly, the batched block
+    pipeline, signature sets and the slasher/fork-choice feed."""
+    import numpy as np
+
+    epoch = compute_epoch_at_slot(data.slot, E)
+    cc = committee_cache_at(state, epoch, E)
+    committee = cc.committee_array(data.slot, data.index)
+    if len(aggregation_bits) != committee.size:
         raise ValueError(
             f"aggregation bits length {len(aggregation_bits)} != committee "
-            f"size {len(committee)}"
+            f"size {committee.size}"
         )
-    return sorted(i for i, bit in zip(committee, aggregation_bits) if bit)
+    mask = np.asarray(aggregation_bits, dtype=bool)
+    picked = committee[mask]
+    picked = np.sort(picked)
+    return picked
+
+
+def get_attesting_indices(state, data, aggregation_bits, E) -> list[int]:
+    # plain ints out (SSZ containers, dict keys, signature sets)
+    return attesting_indices_array(state, data, aggregation_bits, E).tolist()
 
 
 def get_indexed_attestation(state, attestation, E):

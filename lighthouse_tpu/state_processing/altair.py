@@ -203,9 +203,10 @@ def process_attestation_altair(
 # --- Sync committees --------------------------------------------------------
 
 
-def get_next_sync_committee_indices(state, E) -> list[int]:
-    """altair/beacon-chain.md get_next_sync_committee_indices: effective-
-    balance-weighted sampling over the shuffled active set."""
+def get_next_sync_committee_indices_reference(state, E) -> list[int]:
+    """altair/beacon-chain.md get_next_sync_committee_indices, verbatim:
+    one shuffled-index computation and one hash per candidate. Retained
+    as the differential oracle for the batched sampler below."""
     from ..types.chain_spec import Domain
     from ..utils.hash import sha256 as hash_bytes
 
@@ -225,6 +226,77 @@ def get_next_sync_committee_indices(state, E) -> list[int]:
         if effective_balance * 255 >= E.MAX_EFFECTIVE_BALANCE * random_byte:
             indices.append(candidate)
         i += 1
+    return indices
+
+
+def get_next_sync_committee_indices(state, E) -> list[int]:
+    """Batched effective-balance-weighted sampling: the whole shuffled
+    permutation is computed once (one batched-hash pass per swap-or-not
+    round, shuffle._shuffled_positions) instead of one
+    `compute_shuffled_index` walk per candidate, and each 32-candidate
+    window's randomness is ONE `hash_messages` call over the window seeds
+    rather than 32 sequential hashlib calls. Selection order and output
+    are bit-identical to the reference above (asserted by the
+    differential suite)."""
+    from ..types.chain_spec import Domain
+    from ..utils.sha256_batch import hash_messages
+    from .shuffle import _shuffled_positions
+
+    epoch = get_current_epoch(state, E) + 1
+    active = np.asarray(get_active_validator_indices(state, epoch), dtype=np.int64)
+    active_count = int(active.size)
+    seed = get_seed(state, epoch, Domain.SYNC_COMMITTEE, E)
+    if active_count > 1:
+        candidates = active[
+            _shuffled_positions(active_count, seed, E.SHUFFLE_ROUND_COUNT)
+        ]
+    else:
+        candidates = active
+    from .registry_columns import registry_columns_for
+
+    cols = registry_columns_for(state)
+    if cols is not None:
+        cols.refresh(state)
+    # u64-exactness: eff·255 < 2^49 and max_eb·byte < 2^49 even at the
+    # electra 2048-ETH ceiling, so the acceptance test vectorizes exactly
+    max_eb = np.uint64(E.MAX_EFFECTIVE_BALANCE)
+    indices: list[int] = []
+    window = 0
+    # hash a handful of 32-candidate windows per batch call (at ~50%
+    # acceptance the committee needs ~SYNC_COMMITTEE_SIZE/16 windows),
+    # and gather effective balances ONLY for the candidates actually
+    # examined — the committee normally samples a tiny prefix of the
+    # shuffled cycle, so a whole-active-set gather (or a per-validator
+    # object pass on plain chains) would dwarf the sampling itself
+    batch = max(1, E.SYNC_COMMITTEE_SIZE // 16)
+    need = E.SYNC_COMMITTEE_SIZE
+    while len(indices) < need:
+        msgs = np.frombuffer(
+            b"".join(
+                seed + (window + w).to_bytes(8, "little") for w in range(batch)
+            ),
+            dtype=np.uint8,
+        ).reshape(batch, 40)
+        randomness = hash_messages(msgs).reshape(-1)  # batch*32 bytes
+        pos = (
+            np.arange(window * 32, (window + batch) * 32, dtype=np.int64)
+            % active_count
+        )
+        cand = candidates[pos]
+        if cols is not None:
+            eff = cols.effective_balance[cand]
+        else:
+            vs = state.validators
+            eff = np.fromiter(
+                (vs[int(c)].effective_balance for c in cand.tolist()),
+                dtype=np.uint64,
+                count=int(cand.size),
+            )
+        ok = eff * np.uint64(255) >= max_eb * randomness.astype(np.uint64)
+        picked = cand[ok]
+        take = min(need - len(indices), int(picked.size))
+        indices.extend(picked[:take].tolist())
+        window += batch
     return indices
 
 
@@ -356,6 +428,19 @@ def _validator_index_of(state, pubkey: bytes) -> int:
 # --- Vectorized epoch processing -------------------------------------------
 
 
+def _participation_array(field, column, n: int) -> np.ndarray:
+    """Participation flags as a [n] uint8 array: the resident column when
+    attached (zero-copy view), `np.frombuffer` for the plain-bytearray
+    representation, and a one-shot `load_array` extraction for a
+    persistent list without columns (the LIGHTHOUSE_TPU_RESIDENT_COLUMNS=0
+    oracle path)."""
+    if column is not None:
+        return column
+    if isinstance(field, (bytes, bytearray)):
+        return np.frombuffer(field, dtype=np.uint8, count=n)
+    return field.load_array()
+
+
 class EpochArrays:
     """Flat-array registry view for one epoch transition — the TPU-side
     layout (single_pass.rs's per-validator struct turned into columns).
@@ -401,11 +486,15 @@ class EpochArrays:
                 (v.slashed for v in vs), dtype=bool, count=n
             )
         if hasattr(state, "previous_epoch_participation"):
-            self.prev_participation = np.frombuffer(
-                state.previous_epoch_participation, dtype=np.uint8, count=n
+            self.prev_participation = _participation_array(
+                state.previous_epoch_participation,
+                None if columns is None else columns.previous_epoch_participation,
+                n,
             )
-            self.curr_participation = np.frombuffer(
-                state.current_epoch_participation, dtype=np.uint8, count=n
+            self.curr_participation = _participation_array(
+                state.current_epoch_participation,
+                None if columns is None else columns.current_epoch_participation,
+                n,
             )
         else:  # phase0: no participation flags
             self.prev_participation = None
@@ -764,6 +853,27 @@ def process_slashings_altair(state, E, fork: ForkName, arrays: EpochArrays | Non
 
 
 def process_participation_flag_updates(state, E):
+    from ..ssz.persistent import PersistentByteList
+
+    cur = state.current_epoch_participation
+    if isinstance(cur, PersistentByteList):
+        # persistent rotation: previous adopts current's blocks AND dirt
+        # tokens (coerce takes a CoW copy), current becomes a fresh zero
+        # list — then the hash cache and the resident columns rotate
+        # their per-field entries along so the committed-token lineage
+        # survives the epoch boundary (no full rebuilds, no full diffs
+        # on the next block's sparse re-root).
+        state.previous_epoch_participation = cur
+        state.current_epoch_participation = PersistentByteList(
+            bytes(len(state.validators))
+        )
+        cache = state.__dict__.get("_thc_cache")
+        if cache is not None:
+            cache.rotate_participation()
+        cols = state.__dict__.get("_registry_columns")
+        if cols is not None:
+            cols.rotate_participation(state)
+        return
     state.previous_epoch_participation = bytearray(state.current_epoch_participation)
     state.current_epoch_participation = bytearray(len(state.validators))
 

@@ -85,6 +85,18 @@ class ConsensusContext:
             self._indexed_attestations[key] = cached
         return cached
 
+    def peek_indexed_attestation(self, attestation):
+        """The memoized indexed attestation, or None — the batched
+        attestation pipeline checks before assembling its own from the
+        columnar committee gather."""
+        return self._indexed_attestations.get(id(attestation))
+
+    def set_indexed_attestation(self, attestation, indexed):
+        """Memoize an indexed attestation assembled elsewhere (the batch
+        pipeline), so signature verification, fork choice and the slasher
+        feed reuse the same arrays instead of re-deriving committees."""
+        self._indexed_attestations[id(attestation)] = indexed
+
 
 # ---------------------------------------------------------------------------
 # Signature verification
@@ -383,12 +395,44 @@ def process_randao(state, block, spec: ChainSpec, E, verify: bool):
     state.randao_mixes[epoch % E.EPOCHS_PER_HISTORICAL_VECTOR] = mix
 
 
-def process_eth1_data(state, eth1_data, E):
-    state.eth1_data_votes.append(eth1_data)
+def eth1_data_vote_count_scan(state, eth1_data) -> int:
+    """The original linear SSZ-equality scan over the votes list —
+    retained as the differential oracle for the serialized-bytes tally."""
+    return state.eth1_data_votes.count(eth1_data)
+
+
+def _eth1_vote_tally(state) -> dict:
+    """Per-state serialized-bytes tally of eth1_data_votes, kept alongside
+    the list so each block pays one dict bump instead of an O(votes)
+    container-equality scan. Eth1Data is fixed-size with bijective
+    serialization, so byte equality IS SSZ equality. The tally lives
+    outside the SSZ fields (state.copy() drops it; a copy rebuilds
+    lazily) and is invalidated whenever the votes list is replaced or
+    its length moved without us (period-boundary reset, replayed
+    states)."""
+    votes = state.eth1_data_votes
+    tally = state.__dict__.get("_lh_eth1_tally")
     if (
-        state.eth1_data_votes.count(eth1_data) * 2
-        > E.slots_per_eth1_voting_period()
+        tally is None
+        or tally["list_id"] != id(votes)
+        or tally["len"] != len(votes)
     ):
+        counts: dict[bytes, int] = {}
+        for v in votes:
+            key = v.serialize()
+            counts[key] = counts.get(key, 0) + 1
+        tally = {"list_id": id(votes), "len": len(votes), "counts": counts}
+        state.__dict__["_lh_eth1_tally"] = tally
+    return tally
+
+
+def process_eth1_data(state, eth1_data, E):
+    tally = _eth1_vote_tally(state)
+    state.eth1_data_votes.append(eth1_data)
+    key = eth1_data.serialize()
+    tally["counts"][key] = tally["counts"].get(key, 0) + 1
+    tally["len"] = len(state.eth1_data_votes)
+    if tally["counts"][key] * 2 > E.slots_per_eth1_voting_period():
         state.eth1_data = eth1_data
 
 
@@ -428,12 +472,11 @@ def process_operations(
     for asl in body.attester_slashings:
         process_attester_slashing(state, asl, spec, E, verify_signatures)
     if fork >= ForkName.ALTAIR:
-        from .altair import process_attestation_altair
+        from .attestation_batch import process_attestations
 
-        for att in body.attestations:
-            process_attestation_altair(
-                state, att, spec, E, verify_signatures, ctxt, fork
-            )
+        process_attestations(
+            state, body.attestations, spec, E, verify_signatures, ctxt, fork
+        )
     else:
         for att in body.attestations:
             process_attestation(state, att, spec, E, verify_signatures, ctxt)
@@ -537,20 +580,26 @@ def process_attestation(
         inclusion_delay=state.slot - data.slot,
         proposer_index=ctxt.get_proposer_index(state, E),
     )
+    # validate EVERYTHING before the pending-attestation append: a
+    # rejected attestation must leave no partial writes (the old order
+    # appended first, so a bad indexed attestation left a phantom
+    # PendingAttestation on the discarded state copy)
     if data.target.epoch == current:
         if data.source != state.current_justified_checkpoint:
             raise BlockProcessingError("attestation: wrong source (current)")
-        state.current_epoch_attestations.append(pending)
-    else:
-        if data.source != state.previous_justified_checkpoint:
-            raise BlockProcessingError("attestation: wrong source (previous)")
-        state.previous_epoch_attestations.append(pending)
+    elif data.source != state.previous_justified_checkpoint:
+        raise BlockProcessingError("attestation: wrong source (previous)")
 
     indexed = ctxt.get_indexed_attestation(state, attestation, E)
     if not is_valid_indexed_attestation(
         state, indexed, spec, E, verify_signature=verify_signatures
     ):
         raise BlockProcessingError("attestation: invalid indexed attestation")
+
+    if data.target.epoch == current:
+        state.current_epoch_attestations.append(pending)
+    else:
+        state.previous_epoch_attestations.append(pending)
 
 
 # ---------------------------------------------------------------------------

@@ -43,7 +43,11 @@ from __future__ import annotations
 import numpy as np
 
 from ..metrics import REGISTRY
-from ..ssz.persistent import PersistentContainerList, PersistentList
+from ..ssz.persistent import (
+    PersistentByteList,
+    PersistentContainerList,
+    PersistentList,
+)
 
 # The dirty channel this mirror consumes (the hash caches drain the
 # default channel; see ssz/persistent.py::_DirtyTracking).
@@ -71,7 +75,13 @@ _WRITEBACKS = REGISTRY.counter(
     "registry_columns_row_writebacks_total",
     "rows written back from resident columns into the persistent lists",
 )
-for _field in ("validators", "balances", "inactivity_scores"):
+for _field in (
+    "validators",
+    "balances",
+    "inactivity_scores",
+    "previous_epoch_participation",
+    "current_epoch_participation",
+):
     _REBUILDS.inc(0, field=_field)
     _WRITEBACKS.inc(0, field=_field)
 
@@ -187,6 +197,14 @@ class RegistryColumns:
         return self._cols.get("inactivity_scores")
 
     @property
+    def previous_epoch_participation(self) -> np.ndarray | None:
+        return self._cols.get("previous_epoch_participation")
+
+    @property
+    def current_epoch_participation(self) -> np.ndarray | None:
+        return self._cols.get("current_epoch_participation")
+
+    @property
     def validator_count(self) -> int:
         arr = self._cols.get("effective_balance")
         return 0 if arr is None else int(arr.size)
@@ -220,6 +238,17 @@ class RegistryColumns:
         scores = getattr(state, "inactivity_scores", None)
         if isinstance(scores, PersistentList):
             self._refresh_uint64("inactivity_scores", scores)
+        for fname in (
+            "previous_epoch_participation",
+            "current_epoch_participation",
+        ):
+            part = getattr(state, fname, None)
+            if isinstance(part, PersistentByteList):
+                # same delta protocol as the uint64 columns — load_array/
+                # per-index reads are dtype-agnostic (uint8 here)
+                self._refresh_uint64(fname, part)
+
+    _EMPTY_IDX = np.zeros(0, dtype=np.int64)
 
     def _sparse_indices(self, field: str, lst, n: int, old_n: int | None):
         """Drain the field's channel; return the exact dirty row indices
@@ -234,6 +263,10 @@ class RegistryColumns:
             or n < old_n
         ):
             return None
+        if not dirty and n == old_n:
+            # steady-state refresh (block import re-syncs several times
+            # per block): nothing changed, skip the unique/fromiter setup
+            return self._EMPTY_IDX
         idx = np.unique(
             np.fromiter((i for i in dirty if i < n), dtype=np.int64)
         )
@@ -365,18 +398,21 @@ class RegistryColumns:
 
     # -- writeback (columns → list) --------------------------------------
 
-    def _write_uint64(self, field: str, lst: PersistentList, new) -> int:
+    def _write_col(
+        self, field: str, lst, new, dtype, changed: np.ndarray | None = None
+    ) -> int:
         # re-sync first: pending object-path writes (deposits, per-index
         # balance ops) since the last refresh must land in the column
         # before it can serve as the diff baseline
         self._refresh_uint64(field, lst)
-        new = np.ascontiguousarray(new, dtype=np.uint64)
+        new = np.ascontiguousarray(new, dtype=dtype)
         cur = self._cols[field]
         if new.size != cur.size:
             raise ValueError(
                 f"{field} writeback length {new.size} != {cur.size}"
             )
-        changed = np.nonzero(cur != new)[0]
+        if changed is None:
+            changed = np.nonzero(cur != new)[0]
         if changed.size == 0:
             return 0
         lst.store_array(new, changed, exclude_channel=COLUMNS_CHANNEL)
@@ -384,6 +420,9 @@ class RegistryColumns:
         col[changed] = new[changed]
         _WRITEBACKS.inc(int(changed.size), field=field)
         return int(changed.size)
+
+    def _write_uint64(self, field: str, lst: PersistentList, new) -> int:
+        return self._write_col(field, lst, new, np.uint64)
 
     def write_balances(self, state, new) -> int:
         """Commit an epoch sweep's balance array: vectorized diff, bulk
@@ -395,6 +434,47 @@ class RegistryColumns:
         return self._write_uint64(
             "inactivity_scores", state.inactivity_scores, new
         )
+
+    def write_participation(
+        self, state, field: str, new, changed: np.ndarray | None = None
+    ) -> int:
+        """Commit a batched attestation pass's participation array for
+        `field` ('previous_epoch_participation' /
+        'current_epoch_participation'). `changed` (sorted row indices)
+        skips the whole-column diff when the writer already knows its
+        exact scatter set — the attestation pipeline does."""
+        return self._write_col(
+            field, getattr(state, field), new, np.uint8, changed
+        )
+
+    def rotate_participation(self, state):
+        """Epoch-boundary rotation (process_participation_flag_updates):
+        the state's previous field now holds a CoW copy of the old
+        current list (same dirt tokens), and current is a fresh all-zero
+        list. Move the column + its committed token along and install a
+        zeros column for current — committing the fresh list's token
+        directly, so the steady-state epoch still rebuilds ZERO columns."""
+        cur_col = self._cols.pop("current_epoch_participation", None)
+        cur_tok = self._committed.pop("current_epoch_participation", None)
+        if cur_col is not None:
+            self._cols["previous_epoch_participation"] = cur_col
+            if "current_epoch_participation" in self._shared:
+                self._shared.add("previous_epoch_participation")
+            else:
+                self._shared.discard("previous_epoch_participation")
+            self._committed["previous_epoch_participation"] = cur_tok
+        fresh = getattr(state, "current_epoch_participation", None)
+        if isinstance(fresh, PersistentByteList):
+            self._install(
+                "current_epoch_participation",
+                np.zeros(len(fresh), dtype=np.uint8),
+            )
+            self._committed["current_epoch_participation"] = (
+                fresh.dirt_token_for(COLUMNS_CHANNEL)
+            )
+        else:
+            self._cols.pop("current_epoch_participation", None)
+            self._committed.pop("current_epoch_participation", None)
 
     # -- element roots for the hash caches -------------------------------
 

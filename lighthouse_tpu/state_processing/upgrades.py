@@ -28,6 +28,17 @@ def _persistent_like(template, values):
     return values
 
 
+def _participation_like(template, n: int):
+    """Fresh zeroed participation flags matching the chain's persistence
+    (PersistentByteList on tree-states chains, bytearray otherwise — the
+    resident columns only engage when every mirrored field is persistent)."""
+    from ..ssz.persistent import PersistentByteList, PersistentList
+
+    if isinstance(template, PersistentList):
+        return PersistentByteList(bytes(n))
+    return bytearray(n)
+
+
 def _swap_class(state, new_cls, new_field_values: dict):
     """Re-class `state` to the next fork variant; new fields are coerced by
     the container's field machinery."""
@@ -82,8 +93,12 @@ def upgrade_to_altair(state, spec: ChainSpec, E):
         state,
         t.BeaconStateAltair,
         dict(
-            previous_epoch_participation=[0] * n,
-            current_epoch_participation=[0] * n,
+            previous_epoch_participation=_participation_like(
+                state.balances, n
+            ),
+            current_epoch_participation=_participation_like(
+                state.balances, n
+            ),
             # stays structurally-shared across copies if balances already is
             inactivity_scores=_persistent_like(state.balances, [0] * n),
             current_sync_committee=t.SyncCommittee.default(),

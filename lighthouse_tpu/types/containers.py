@@ -14,7 +14,11 @@ are built inside a function scope.
 import functools
 from types import SimpleNamespace
 
-from ..ssz.persistent import PersistentContainerList, PersistentList
+from ..ssz.persistent import (
+    PersistentByteList,
+    PersistentContainerList,
+    PersistentList,
+)
 from ..ssz.core import (
     Bitlist,
     Bitvector,
@@ -322,6 +326,11 @@ def build_types(E: type) -> SimpleNamespace:
             ("validators", PersistentContainerList),
             ("balances", PersistentList),
             ("inactivity_scores", PersistentList),
+            # the attestation pipeline's scatter target: participation is
+            # resident too (columns engage only when every field is
+            # persistent — chain._make_persistent converts all of them)
+            ("previous_epoch_participation", PersistentByteList),
+            ("current_epoch_participation", PersistentByteList),
         )
 
     # -- Bellatrix (execution payloads) ------------------------------------

@@ -50,7 +50,11 @@ def _walk(a, b, path, diffs, max_diffs):
                 max_diffs,
             )
         return
-    _plist_names = ("PersistentList", "PersistentContainerList")
+    _plist_names = (
+        "PersistentList",
+        "PersistentContainerList",
+        "PersistentByteList",
+    )
     a_listy = isinstance(a, (list, tuple)) or type(a).__name__ in _plist_names
     b_listy = isinstance(b, (list, tuple)) or type(b).__name__ in _plist_names
     if a_listy and b_listy:

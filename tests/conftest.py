@@ -33,6 +33,7 @@ def pytest_sessionstart(session):
     from lighthouse_tpu.metrics import REGISTRY
     from lighthouse_tpu.network import sync  # noqa: F401 — registers sync series
     from lighthouse_tpu.state_processing import (  # noqa: F401 — registers
+        attestation_batch,  # the batch path counter + attestation_apply span
         registry_columns,  # the columns counters + epoch_stage spans
     )
 
@@ -77,6 +78,18 @@ def pytest_sessionstart(session):
         "trace_span_seconds_epoch_stage_slashings",
         "trace_span_seconds_epoch_stage_effective_balances",
         "trace_span_seconds_epoch_stage_final_updates",
+        # PR 7: the columnar attestation pipeline's path counter, the
+        # participation-column counters, and the apply span must exist at
+        # zero — the attestation_batch bench and the perf_smoke
+        # no-scalar-fallback guard read them eagerly
+        'attestation_batch_total{path="columnar"}',
+        'attestation_batch_total{path="scalar"}',
+        'attestation_batch_total{path="scalar_small"}',
+        'registry_columns_rebuilds_total{field="previous_epoch_participation"}',
+        'registry_columns_rebuilds_total{field="current_epoch_participation"}',
+        'registry_columns_row_writebacks_total{field="previous_epoch_participation"}',
+        'registry_columns_row_writebacks_total{field="current_epoch_participation"}',
+        "trace_span_seconds_attestation_apply",
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"
