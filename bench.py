@@ -1151,6 +1151,7 @@ def main():
     # budget remains and, when it completes, takes over the final line.
     import subprocess
 
+    _refuse_sanitize_mode()
     budget = float(os.environ.get("BENCH_BUDGET_S", "1200"))
     deadline = time.monotonic() + budget
     details = []
@@ -1240,6 +1241,25 @@ def main():
     emit(head if head is not None else details[0])
 
 
+def _refuse_sanitize_mode():
+    """Sanitize mode write-guards buffers and runs wide-dtype checks on
+    every sweep — numbers recorded under it are not comparable to the
+    baselines (BENCH_NOTES.md "Sanitize mode"). Refuse, loudly."""
+    if os.environ.get("LIGHTHOUSE_TPU_SANITIZE") == "1":
+        print(
+            json.dumps(
+                {
+                    "error": (
+                        "refusing to record timed trials with "
+                        "LIGHTHOUSE_TPU_SANITIZE=1 set — sanitize mode is "
+                        "excluded from benchmarks; unset it and re-run"
+                    )
+                }
+            )
+        )
+        sys.exit(2)
+
+
 def _parse_args(argv: list[str]) -> list[str]:
     """Strip --bls-backend (propagated via env to metric subprocesses)."""
     out = []
@@ -1261,6 +1281,9 @@ def _parse_args(argv: list[str]) -> list[str]:
 
 if __name__ == "__main__":
     argv = _parse_args(sys.argv[1:])
+    # covers the --metric subprocess entry too: no timed trial ever runs
+    # with the sanitizer's guards armed
+    _refuse_sanitize_mode()
     if len(argv) == 2 and argv[0] == "--metric":
         sys.exit(_run_one(argv[1]))
     sys.exit(main())

@@ -1,0 +1,17 @@
+"""beacon-san: project-specific static analysis + runtime sanitizer.
+
+Two halves, one correctness-tooling layer for the tree-states protocol:
+
+* `lint` — an AST linter (`python -m lighthouse_tpu.analysis <paths>`)
+  with four project rule families: safe-arith, cow-aliasing,
+  fork-safety, dirty-channel. tests/test_static_analysis.py runs it over
+  the whole package in tier-1; a new violation fails the suite.
+* `sanitizer` — runtime write-guards, wide-dtype overflow checks, and
+  stale-read audits behind ``LIGHTHOUSE_TPU_SANITIZE=1``, surfaced
+  through ``sanitizer_violations_total{rule=...}``.
+
+See ANALYSIS.md for rules, suppression syntax and sanitizer knobs.
+"""
+
+from .lint import RULES, Violation, lint_paths, lint_source, main  # noqa: F401
+from .sanitizer import SanitizerError, enabled as sanitize_enabled  # noqa: F401

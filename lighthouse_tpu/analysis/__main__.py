@@ -1,0 +1,7 @@
+"""`python -m lighthouse_tpu.analysis <paths>` — run the beacon-san lint."""
+
+import sys
+
+from .lint import main
+
+sys.exit(main())

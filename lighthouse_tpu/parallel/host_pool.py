@@ -24,7 +24,13 @@ Fork-safety rule for task functions: a forked child inherits every lock in
 whatever state some other parent thread held it at fork time, so task
 functions must be lock-free pure Python — no metrics registry, no logging,
 plain-dict caches only (see crypto/bls's `_prep_chunk` family). The pool
-itself only touches the metrics registry from the parent process.
+itself only touches the metrics registry from the parent process: counters
+are incremented parent-side, workers return plain data for the parent to
+tally. This rule is MACHINE-CHECKED by the beacon-san linter's
+`fork-safety` rule (lighthouse_tpu/analysis, run over the whole package by
+tests/test_static_analysis.py): every callable submitted to `map` is
+resolved (one import hop) and its same-module call graph scanned for
+metrics/logging/span/jax/lock references.
 
 Failure surface: a task exception propagates out of `map` (remaining tasks
 are cancelled); a dead worker raises `BrokenProcessPool`, after which the

@@ -381,7 +381,11 @@ class PersistentList(_DirtyTracking):
 
     def load_array(self):
         """The whole list as a [n] uint64 array — one C-speed conversion
-        per block instead of a per-element Python iteration."""
+        per block instead of a per-element Python iteration. Under
+        LIGHTHOUSE_TPU_SANITIZE=1 the returned array is a read-only
+        guarded view: an escaped consumer that writes it (instead of
+        committing through `store_array`) raises a counted sanitizer
+        violation at the write site."""
         import numpy as np
 
         out = np.empty(len(self), dtype=np.uint64)
@@ -389,7 +393,9 @@ class PersistentList(_DirtyTracking):
         for blk in self._blocks:
             out[pos : pos + len(blk.items)] = blk.items
             pos += len(blk.items)
-        return out
+        from ..analysis.sanitizer import guard
+
+        return guard(out)
 
     def store_array(self, new, changed=None, exclude_channel=None) -> int:
         """Bulk same-length store from a [n] uint64 array.
@@ -622,7 +628,8 @@ class PersistentByteList(_DirtyTracking):
     # -- bulk numpy interchange (the attestation-pipeline fast path) ------
 
     def load_array(self):
-        """The whole list as a [n] uint8 array."""
+        """The whole list as a [n] uint8 array (read-only guarded view
+        under LIGHTHOUSE_TPU_SANITIZE=1 — the PersistentList contract)."""
         import numpy as np
 
         out = np.empty(len(self), dtype=np.uint8)
@@ -632,7 +639,9 @@ class PersistentByteList(_DirtyTracking):
                 blk.items, dtype=np.uint8
             )
             pos += len(blk.items)
-        return out
+        from ..analysis.sanitizer import guard
+
+        return guard(out)
 
     def store_array(self, new, changed=None, exclude_channel=None) -> int:
         """Bulk same-length store from a [n] uint8 array; only elements at

@@ -12,6 +12,7 @@ from dataclasses import dataclass
 
 from ..types.chain_spec import FAR_FUTURE_EPOCH, ChainSpec, Domain
 from ..utils.hash import sha256 as hash_bytes
+from ..utils.safe_arith import safe_add, safe_div, safe_mul, saturating_sub
 from .shuffle import compute_shuffled_index
 
 MAX_RANDOM_BYTE = 255
@@ -181,6 +182,10 @@ class CommitteeCache:
             shuffled = active[perm]
         else:
             shuffled = active
+        # freeze the permutation in ALL modes: every committee is a
+        # zero-copy slice of it, and an in-place write through one slice
+        # would silently corrupt every later consumer's assignment
+        shuffled.setflags(write=False)
         return cls(
             epoch=epoch,
             seed=seed,
@@ -275,7 +280,7 @@ def compute_proposer_index(state, indices: list[int], seed: bytes, E) -> int:
         candidate = indices[compute_shuffled_index(i % total, total, seed, E.SHUFFLE_ROUND_COUNT)]
         random_byte = hash_bytes(seed + (i // 32).to_bytes(8, "little"))[i % 32]
         eff = state.validators[candidate].effective_balance
-        if eff * MAX_RANDOM_BYTE >= E.MAX_EFFECTIVE_BALANCE * random_byte:
+        if safe_mul(eff, MAX_RANDOM_BYTE) >= E.MAX_EFFECTIVE_BALANCE * random_byte:
             return candidate
         i += 1
 
@@ -323,12 +328,12 @@ def increase_balance(state, index: int, delta: int):
     # keeps the registry's dirty-index tracker (ssz/persistent.py) from
     # recording — and the hash cache from re-rooting — untouched paths
     if delta:
-        state.balances[index] += delta
+        state.balances[index] = safe_add(state.balances[index], delta)
 
 
 def decrease_balance(state, index: int, delta: int):
     if delta:
-        state.balances[index] = max(0, state.balances[index] - delta)
+        state.balances[index] = saturating_sub(state.balances[index], delta)
 
 
 # ---------------------------------------------------------------------------
@@ -478,7 +483,10 @@ def slash_validator(
     v.withdrawable_epoch = max(
         v.withdrawable_epoch, epoch + E.EPOCHS_PER_SLASHINGS_VECTOR
     )
-    state.slashings[epoch % E.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
+    state.slashings[epoch % E.EPOCHS_PER_SLASHINGS_VECTOR] = safe_add(
+        state.slashings[epoch % E.EPOCHS_PER_SLASHINGS_VECTOR],
+        v.effective_balance,
+    )
     if fork >= ForkName.ELECTRA:
         quotient = spec.min_slashing_penalty_quotient_electra
     elif fork >= ForkName.BELLATRIX:
@@ -487,7 +495,7 @@ def slash_validator(
         quotient = E.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
     else:
         quotient = E.MIN_SLASHING_PENALTY_QUOTIENT
-    decrease_balance(state, slashed_index, v.effective_balance // quotient)
+    decrease_balance(state, slashed_index, safe_div(v.effective_balance, quotient))
     proposer_index = get_beacon_proposer_index(state, E)
     if whistleblower_index is None:
         whistleblower_index = proposer_index
@@ -496,7 +504,7 @@ def slash_validator(
         if fork >= ForkName.ELECTRA
         else E.WHISTLEBLOWER_REWARD_QUOTIENT
     )
-    whistleblower_reward = v.effective_balance // wb_quotient
+    whistleblower_reward = safe_div(v.effective_balance, wb_quotient)
     if fork >= ForkName.ALTAIR:
         from .altair import PROPOSER_WEIGHT, WEIGHT_DENOMINATOR
 

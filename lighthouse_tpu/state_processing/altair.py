@@ -20,6 +20,14 @@ from __future__ import annotations
 import numpy as np
 
 from ..types.chain_spec import ChainSpec, ForkName
+from ..utils.safe_arith import (
+    add_u64,
+    div_u64,
+    mul_u64,
+    safe_div,
+    safe_mul,
+    sub_u64_saturating,
+)
 from .accessors import (
     compute_epoch_at_slot,
     decrease_balance,
@@ -88,8 +96,8 @@ def get_base_reward_per_increment(state, E) -> int:
 
 
 def get_base_reward_altair(state, index: int, E) -> int:
-    increments = (
-        state.validators[index].effective_balance // E.EFFECTIVE_BALANCE_INCREMENT
+    increments = safe_div(
+        state.validators[index].effective_balance, E.EFFECTIVE_BALANCE_INCREMENT
     )
     return increments * get_base_reward_per_increment(state, E)
 
@@ -176,9 +184,9 @@ def process_attestation_altair(
     base_reward_per_increment = get_base_reward_per_increment(state, E)
     proposer_reward_numerator = 0
     for index in indexed.attesting_indices:
-        eb_increments = (
-            state.validators[index].effective_balance
-            // E.EFFECTIVE_BALANCE_INCREMENT
+        eb_increments = safe_div(
+            state.validators[index].effective_balance,
+            E.EFFECTIVE_BALANCE_INCREMENT,
         )
         base_reward = eb_increments * base_reward_per_increment
         flags = participation[index]
@@ -223,7 +231,7 @@ def get_next_sync_committee_indices_reference(state, E) -> list[int]:
         candidate = active[shuffled]
         random_byte = hash_bytes(seed + (i // 32).to_bytes(8, "little"))[i % 32]
         effective_balance = state.validators[candidate].effective_balance
-        if effective_balance * 255 >= E.MAX_EFFECTIVE_BALANCE * random_byte:
+        if safe_mul(effective_balance, 255) >= E.MAX_EFFECTIVE_BALANCE * random_byte:
             indices.append(candidate)
         i += 1
     return indices
@@ -433,12 +441,15 @@ def _participation_array(field, column, n: int) -> np.ndarray:
     attached (zero-copy view), `np.frombuffer` for the plain-bytearray
     representation, and a one-shot `load_array` extraction for a
     persistent list without columns (the LIGHTHOUSE_TPU_RESIDENT_COLUMNS=0
-    oracle path)."""
+    oracle path). Always read-only: the sweep consumers are pure readers,
+    and flag writes go through the attestation pipeline's writers."""
+    from ..analysis.sanitizer import freeze_view
+
     if column is not None:
-        return column
+        return column  # RegistryColumns property: already frozen
     if isinstance(field, (bytes, bytearray)):
-        return np.frombuffer(field, dtype=np.uint8, count=n)
-    return field.load_array()
+        return freeze_view(np.frombuffer(field, dtype=np.uint8, count=n))
+    return freeze_view(field.load_array())
 
 
 class EpochArrays:
@@ -485,6 +496,11 @@ class EpochArrays:
             self._snap["slashed"] = np.fromiter(
                 (v.slashed for v in vs), dtype=bool, count=n
             )
+            # write-guard the snapshot buffers in ALL modes: the only
+            # sanctioned write windows are write_snapshot_rows and
+            # refresh_rows (sanitizer.writable_window re-enables inside)
+            for arr in self._snap.values():
+                arr.setflags(write=False)
         if hasattr(state, "previous_epoch_participation"):
             self.prev_participation = _participation_array(
                 state.previous_epoch_participation,
@@ -503,7 +519,7 @@ class EpochArrays:
 
     def _col(self, name: str) -> np.ndarray:
         if self.columns is not None:
-            return getattr(self.columns, name)
+            return getattr(self.columns, name)  # frozen by RegistryColumns
         arr = self._snap.get(name)
         if arr is None:
             # snapshot columns the common stages don't need are built
@@ -512,8 +528,28 @@ class EpochArrays:
             arr = np.fromiter(
                 (v.__dict__[name] for v in vs), dtype=np.uint64, count=self.n
             )
+            arr.setflags(write=False)
             self._snap[name] = arr
-        return arr
+        # read-only in ALL modes: sweeps that must write a snapshot
+        # column go through write_snapshot_rows / refresh_rows
+        from ..analysis.sanitizer import freeze_view
+
+        return freeze_view(arr)
+
+    def write_snapshot_rows(self, name: str, idx, values):
+        """Sanctioned in-place update of a legacy snapshot column after
+        targeted object writebacks. Resident columns never take this
+        path — they re-sync from the dirty-channel drain instead (the
+        column may be CoW-shared with other state copies)."""
+        if self.columns is not None:
+            raise ValueError(
+                "write_snapshot_rows is for legacy snapshots; resident "
+                "columns re-sync via refresh()"
+            )
+        from ..analysis.sanitizer import writable_window
+
+        with writable_window(self._snap[name]) as buf:
+            buf[idx] = values
 
     @property
     def effective_balance(self) -> np.ndarray:
@@ -577,17 +613,26 @@ class EpochArrays:
         if self.columns is not None:
             self.columns.refresh(state)
             return
-        for i in indices:
-            v = state.validators[i]
-            self._snap["effective_balance"][i] = v.effective_balance
-            self._snap["activation_epoch"][i] = v.activation_epoch
-            self._snap["exit_epoch"][i] = v.exit_epoch
-            self._snap["withdrawable_epoch"][i] = v.withdrawable_epoch
-            self._snap["slashed"][i] = v.slashed
-            if "activation_eligibility_epoch" in self._snap:
-                self._snap["activation_eligibility_epoch"][i] = (
-                    v.activation_eligibility_epoch
-                )
+        from contextlib import ExitStack
+
+        from ..analysis.sanitizer import writable_window
+
+        with ExitStack() as stack:
+            snap = {
+                name: stack.enter_context(writable_window(arr))
+                for name, arr in self._snap.items()
+            }
+            for i in indices:
+                v = state.validators[i]
+                snap["effective_balance"][i] = v.effective_balance
+                snap["activation_epoch"][i] = v.activation_epoch
+                snap["exit_epoch"][i] = v.exit_epoch
+                snap["withdrawable_epoch"][i] = v.withdrawable_epoch
+                snap["slashed"][i] = v.slashed
+                if "activation_eligibility_epoch" in snap:
+                    snap["activation_eligibility_epoch"][i] = (
+                        v.activation_eligibility_epoch
+                    )
 
     def active_at(self, epoch: int) -> np.ndarray:
         e = np.uint64(epoch)
@@ -665,12 +710,12 @@ def process_inactivity_updates(
 
     scores = arrays.load_inactivity_scores(state)
     dec = eligible & participating
-    scores[dec] -= np.minimum(np.uint64(1), scores[dec])
+    scores[dec] = sub_u64_saturating(scores[dec], np.uint64(1))
     inc = eligible & ~participating
-    scores[inc] += np.uint64(spec.inactivity_score_bias)
+    scores[inc] = add_u64(scores[inc], np.uint64(spec.inactivity_score_bias))
     if not get_finality_delay(state, E) > E.MIN_EPOCHS_TO_INACTIVITY_PENALTY:
         recovery = np.uint64(spec.inactivity_score_recovery_rate)
-        scores[eligible] -= np.minimum(recovery, scores[eligible])
+        scores[eligible] = sub_u64_saturating(scores[eligible], recovery)
     arrays.store_inactivity_scores(state, scores)
 
 
@@ -706,10 +751,10 @@ def attestation_flag_deltas(
     base_reward_per_increment = (
         E.EFFECTIVE_BALANCE_INCREMENT * E.BASE_REWARD_FACTOR // int_sqrt(total_active)
     )
-    eb_increments = arrays.effective_balance // np.uint64(
-        E.EFFECTIVE_BALANCE_INCREMENT
+    eb_increments = div_u64(
+        arrays.effective_balance, np.uint64(E.EFFECTIVE_BALANCE_INCREMENT)
     )
-    base_rewards = eb_increments * np.uint64(base_reward_per_increment)
+    base_rewards = mul_u64(eb_increments, np.uint64(base_reward_per_increment))
     total_active_increments = total_active // E.EFFECTIVE_BALANCE_INCREMENT
 
     in_leak = get_finality_delay(state, E) > E.MIN_EPOCHS_TO_INACTIVITY_PENALTY
@@ -731,20 +776,21 @@ def attestation_flag_deltas(
         reward = np.zeros(arrays.n, dtype=np.uint64)
         penalty = np.zeros(arrays.n, dtype=np.uint64)
         if not in_leak:
-            # reward = base * weight * upi // (tai * WD)
-            numer = (
-                base_rewards[got_flag]
-                * np.uint64(weight)
-                * np.uint64(upb_increments)
+            # reward = base * weight * upi // (tai * WD) — u64-exact per
+            # _REWARD_RANGE_DOC; mul_u64 proves it lane-wise in sanitize
+            numer = mul_u64(
+                mul_u64(base_rewards[got_flag], np.uint64(weight)),
+                np.uint64(upb_increments),
             )
-            reward[got_flag] = numer // np.uint64(
-                total_active_increments * WEIGHT_DENOMINATOR
+            reward[got_flag] = div_u64(
+                numer, np.uint64(total_active_increments * WEIGHT_DENOMINATOR)
             )
         if flag_index != TIMELY_HEAD_FLAG_INDEX:
             missed = eligible & ~participating
-            penalty[missed] = (
-                base_rewards[missed] * np.uint64(weight)
-            ) // np.uint64(WEIGHT_DENOMINATOR)
+            penalty[missed] = div_u64(
+                mul_u64(base_rewards[missed], np.uint64(weight)),
+                np.uint64(WEIGHT_DENOMINATOR),
+            )
         flag_rewards.append(reward)
         flag_penalties.append(penalty)
 
@@ -773,8 +819,10 @@ def attestation_flag_deltas(
                 int(arrays.effective_balance[i]) * int(scores[i]) // denom
             )
     else:
-        penalty_numer = arrays.effective_balance[inactive] * scores[inactive]
-        inactivity[inactive] = penalty_numer // np.uint64(denom)
+        penalty_numer = mul_u64(
+            arrays.effective_balance[inactive], scores[inactive]
+        )
+        inactivity[inactive] = div_u64(penalty_numer, np.uint64(denom))
 
     info = {
         "base_reward_per_increment": base_reward_per_increment,
@@ -807,8 +855,8 @@ def process_rewards_and_penalties_altair(
         penalties += penalty
 
     balances = arrays.load_balances(state)
-    balances += rewards
-    balances = np.maximum(balances, penalties) - penalties  # saturating sub
+    balances = add_u64(balances, rewards)
+    balances = sub_u64_saturating(balances, penalties)
     arrays.store_balances(state, balances)
 
 
@@ -848,7 +896,7 @@ def process_slashings_altair(state, E, fork: ForkName, arrays: EpochArrays | Non
             penalties[index] = penalty_numerator // total_balance * increment
     balances = arrays.load_balances(state)
     arrays.store_balances(
-        state, np.maximum(balances, penalties) - penalties
+        state, sub_u64_saturating(balances, penalties)
     )
 
 

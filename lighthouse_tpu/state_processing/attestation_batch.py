@@ -252,9 +252,14 @@ class _ParticipationTarget:
             new[uniq] = new_vals
             self.cols.write_participation(self.state, self.field, new, changed)
         elif self._mode == "plist":
-            self.read[uniq] = new_vals
-            self._lst.store_array(self.read, changed)
+            # never write the load_array view itself: it is a guarded
+            # read surface — stage into a copy and commit via store_array
+            new = self.read.copy()
+            new[uniq] = new_vals
+            self._lst.store_array(new, changed)
+            self.read = new
         else:
+            # lint: allow(cow-aliasing) -- plain-bytearray frombuffer view: the sanctioned in-place representation (no CoW sharing)
             self.read[uniq] = new_vals  # writes through into the bytearray
 
 

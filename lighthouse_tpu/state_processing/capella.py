@@ -8,6 +8,7 @@ arms + signature_sets.rs bls_execution_change_signature_set).
 from __future__ import annotations
 
 from ..types.chain_spec import ChainSpec, Domain, compute_signing_root
+from ..utils.safe_arith import safe_sub
 from .accessors import (
     decrease_balance,
     get_current_epoch,
@@ -66,7 +67,8 @@ def get_expected_withdrawals(state, E) -> list:
                     index=withdrawal_index,
                     validator_index=validator_index,
                     address=validator.withdrawal_credentials[12:],
-                    amount=balance - E.MAX_EFFECTIVE_BALANCE,
+                    # guarded by is_partially_withdrawable (balance > maxeb)
+                    amount=safe_sub(balance, E.MAX_EFFECTIVE_BALANCE),
                 )
             )
             withdrawal_index += 1

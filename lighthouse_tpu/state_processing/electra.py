@@ -16,6 +16,7 @@ chain is functional end-to-end, not just typed.
 from __future__ import annotations
 
 from ..types.chain_spec import FAR_FUTURE_EPOCH, ChainSpec
+from ..utils.safe_arith import add_u64, safe_add, safe_sub, sub_u64
 from .accessors import (
     compute_activation_exit_epoch,
     decrease_balance,
@@ -167,7 +168,7 @@ def queue_excess_active_balance(state, index: int, spec: ChainSpec, E):
 
     balance = state.balances[index]
     if balance > spec.min_activation_balance:
-        excess = balance - spec.min_activation_balance
+        excess = safe_sub(balance, spec.min_activation_balance)
         state.balances[index] = spec.min_activation_balance
         state.pending_balance_deposits.append(
             build_types(E).PendingBalanceDeposit(index=index, amount=excess)
@@ -280,7 +281,11 @@ def process_execution_layer_withdrawal_request(state, request, spec: ChainSpec, 
         from ..types.containers import build_types
 
         to_withdraw = min(
-            balance - spec.min_activation_balance - pending_balance_to_withdraw,
+            # guarded by has_excess_balance above
+            safe_sub(
+                safe_sub(balance, spec.min_activation_balance),
+                pending_balance_to_withdraw,
+            ),
             amount,
         )
         exit_queue_epoch = compute_exit_epoch_and_update_churn(
@@ -352,20 +357,29 @@ def get_expected_withdrawals_electra(state, spec: ChainSpec, E):
         if (
             v.exit_epoch == FAR_FUTURE_EPOCH
             and v.effective_balance >= spec.min_activation_balance
-            and state.balances[w.index] > spec.min_activation_balance
         ):
-            withdrawable = min(
-                state.balances[w.index] - spec.min_activation_balance, w.amount
+            # spec: withdrawals already produced for this validator in
+            # THIS sweep reduce the balance the excess test sees — each
+            # prior entry was capped at the then-remaining excess, so
+            # the running sum never exceeds balance - min_activation
+            balance = safe_sub(
+                state.balances[w.index],
+                sum(p.amount for p in withdrawals if p.validator_index == w.index),
             )
-            withdrawals.append(
-                t.Withdrawal(
-                    index=withdrawal_index,
-                    validator_index=w.index,
-                    address=v.withdrawal_credentials[12:],
-                    amount=withdrawable,
+            if balance > spec.min_activation_balance:
+                withdrawable = min(
+                    safe_sub(balance, spec.min_activation_balance),
+                    w.amount,
                 )
-            )
-            withdrawal_index += 1
+                withdrawals.append(
+                    t.Withdrawal(
+                        index=withdrawal_index,
+                        validator_index=w.index,
+                        address=v.withdrawal_credentials[12:],
+                        amount=withdrawable,
+                    )
+                )
+                withdrawal_index += 1
         processed_count += 1
     stage1_produced = len(withdrawals)
 
@@ -377,10 +391,15 @@ def get_expected_withdrawals_electra(state, spec: ChainSpec, E):
         v = state.validators[validator_index]
         balance = state.balances[validator_index]
         # partially-withdrawn amounts in stage 1 reduce the visible balance
-        balance -= sum(
-            w.amount
-            for w in withdrawals[:stage1_produced]
-            if w.validator_index == validator_index
+        # (stage 1 caps each entry at the then-remaining excess, so the
+        # per-validator sum never exceeds balance - min_activation)
+        balance = safe_sub(
+            balance,
+            sum(
+                w.amount
+                for w in withdrawals[:stage1_produced]
+                if w.validator_index == validator_index
+            ),
         )
         if is_fully_withdrawable_validator_electra(v, balance, epoch, spec):
             withdrawals.append(
@@ -398,7 +417,10 @@ def get_expected_withdrawals_electra(state, spec: ChainSpec, E):
                     index=withdrawal_index,
                     validator_index=validator_index,
                     address=v.withdrawal_credentials[12:],
-                    amount=balance - get_validator_max_effective_balance(v, spec),
+                    # guarded by is_partially_withdrawable (balance > maxeb)
+                    amount=safe_sub(
+                        balance, get_validator_max_effective_balance(v, spec)
+                    ),
                 )
             )
             withdrawal_index += 1
@@ -483,13 +505,13 @@ def process_effective_balance_updates_electra(state, spec: ChainSpec, E, arrays=
             np.uint64(spec.max_effective_balance_electra),
             np.uint64(spec.min_activation_balance),
         )
-        stale = (balances + np.uint64(down) < effective) | (
-            effective + np.uint64(up) < balances
+        stale = (add_u64(balances, np.uint64(down)) < effective) | (
+            add_u64(effective, np.uint64(up)) < balances
         )
         if not stale.any():
             return
         increment = np.uint64(E.EFFECTIVE_BALANCE_INCREMENT)
-        new_eff = np.minimum(balances - balances % increment, max_eb)
+        new_eff = np.minimum(sub_u64(balances, balances % increment), max_eb)
         stale_idx = np.nonzero(stale)[0]
         vs = state.validators
         if hasattr(vs, "set_fields_bulk"):
@@ -511,14 +533,20 @@ def process_effective_balance_updates_electra(state, spec: ChainSpec, E, arrays=
                     new_eff[i]
                 )
         if arrays.columns is None:
-            arrays.effective_balance[stale_idx] = new_eff[stale_idx]
+            arrays.write_snapshot_rows(
+                "effective_balance", stale_idx, new_eff[stale_idx]
+            )
         return
     for index, v in enumerate(state.validators):
         balance = state.balances[index]
         max_eb = get_validator_max_effective_balance(v, spec)
-        if balance + down < v.effective_balance or v.effective_balance + up < balance:
+        if (
+            safe_add(balance, down) < v.effective_balance
+            or safe_add(v.effective_balance, up) < balance
+        ):
             mutable_validator(state, index).effective_balance = min(
-                balance - balance % E.EFFECTIVE_BALANCE_INCREMENT, max_eb
+                safe_sub(balance, balance % E.EFFECTIVE_BALANCE_INCREMENT),
+                max_eb,
             )
 
 

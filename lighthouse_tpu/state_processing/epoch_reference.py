@@ -1,3 +1,4 @@
+# lint: allow-file(safe-arith) -- retained scalar oracle: exact Python-int spec math, kept verbatim for differential testing
 """Per-validator reference epoch transition — the retained oracle.
 
 A deliberately scalar, spec-shaped translation of the epoch sweeps (one

@@ -138,11 +138,14 @@ def initialize_beacon_state_from_eth1(
     # Process activations
     from .accessors import mutable_validator
 
+    from ..utils.safe_arith import safe_sub
+
     for index in range(len(state.validators)):
         balance = state.balances[index]
         validator = mutable_validator(state, index)
         validator.effective_balance = min(
-            balance - balance % E.EFFECTIVE_BALANCE_INCREMENT,
+            # b - b % inc is exact by construction; safe_sub documents it
+            safe_sub(balance, balance % E.EFFECTIVE_BALANCE_INCREMENT),
             E.MAX_EFFECTIVE_BALANCE,
         )
         if validator.effective_balance == E.MAX_EFFECTIVE_BALANCE:

@@ -9,6 +9,15 @@ so the device (vectorized) epoch path can slot in behind the same functions.
 from __future__ import annotations
 
 from ..types.chain_spec import FAR_FUTURE_EPOCH, GENESIS_EPOCH, ChainSpec
+from ..utils.safe_arith import (
+    add_u64,
+    div_u64,
+    mul_u64,
+    safe_div,
+    safe_mul,
+    sub_u64,
+    sub_u64_saturating,
+)
 from .accessors import (
     compute_activation_exit_epoch,
     mutable_validator,
@@ -221,7 +230,10 @@ def weigh_justification_and_finalization(
 
 def get_base_reward(state, index: int, total_balance: int, E) -> int:
     eff = state.validators[index].effective_balance
-    return eff * E.BASE_REWARD_FACTOR // int_sqrt(total_balance) // BASE_REWARDS_PER_EPOCH
+    return safe_div(
+        safe_div(safe_mul(eff, E.BASE_REWARD_FACTOR), int_sqrt(total_balance)),
+        BASE_REWARDS_PER_EPOCH,
+    )
 
 
 def get_proposer_reward(state, index: int, total_balance: int, E) -> int:
@@ -328,6 +340,7 @@ def get_attestation_deltas_reference(state, E):
             )
             if index not in target_attesters:
                 penalties[index] += (
+                    # lint: allow(safe-arith) -- retained phase0 oracle, exact Python-int math kept verbatim
                     state.validators[index].effective_balance
                     * finality_delay
                     // E.INACTIVITY_PENALTY_QUOTIENT
@@ -365,11 +378,12 @@ def get_attestation_deltas(state, E, arrays=None):
     eligible = prev_active | (
         arrays.slashed & (np.uint64(previous + 1) < arrays.withdrawable_epoch)
     )
-    base = (
-        eff
-        * np.uint64(E.BASE_REWARD_FACTOR)
-        // np.uint64(int_sqrt(total_balance))
-        // np.uint64(BASE_REWARDS_PER_EPOCH)
+    base = div_u64(
+        div_u64(
+            mul_u64(eff, np.uint64(E.BASE_REWARD_FACTOR)),
+            np.uint64(int_sqrt(total_balance)),
+        ),
+        np.uint64(BASE_REWARDS_PER_EPOCH),
     )
     proposer_r = base // np.uint64(E.PROPOSER_REWARD_QUOTIENT)
 
@@ -444,9 +458,9 @@ def get_attestation_deltas(state, E, arrays=None):
                     int(eff[i]) * finality_delay // E.INACTIVITY_PENALTY_QUOTIENT
                 )
         else:
-            penalties[inactive] += (
-                eff[inactive] * np.uint64(finality_delay)
-                // np.uint64(E.INACTIVITY_PENALTY_QUOTIENT)
+            penalties[inactive] += div_u64(
+                mul_u64(eff[inactive], np.uint64(finality_delay)),
+                np.uint64(E.INACTIVITY_PENALTY_QUOTIENT),
             )
     return rewards, penalties
 
@@ -475,8 +489,8 @@ def process_rewards_and_penalties(state, spec: ChainSpec, E, arrays=None):
         arrays = EpochArrays(state, E)
     rewards, penalties = get_attestation_deltas(state, E, arrays=arrays)
     balances = arrays.load_balances(state)
-    balances += rewards
-    balances = np.maximum(balances, penalties) - penalties  # saturating sub
+    balances = add_u64(balances, rewards)
+    balances = sub_u64_saturating(balances, penalties)
     arrays.store_balances(state, balances)
 
 
@@ -613,6 +627,7 @@ def process_slashings_reference(state, E):
     for index, v in enumerate(state.validators):
         if v.slashed and epoch + E.EPOCHS_PER_SLASHINGS_VECTOR // 2 == v.withdrawable_epoch:
             penalty = (
+                # lint: allow(safe-arith) -- retained phase0 oracle, exact Python-int math kept verbatim
                 v.effective_balance // increment * adjusted // total_balance * increment
             )
             decrease_balance(state, index, penalty)
@@ -645,7 +660,7 @@ def process_slashings(state, E, arrays=None):
         penalties[index] = eb // increment * adjusted // total_balance * increment
     balances = arrays.load_balances(state)
     arrays.store_balances(
-        state, np.maximum(balances, penalties) - penalties
+        state, sub_u64_saturating(balances, penalties)
     )
 
 
@@ -675,12 +690,15 @@ def process_effective_balance_updates(state, E, arrays=None):
     hysteresis_increment = E.EFFECTIVE_BALANCE_INCREMENT // E.HYSTERESIS_QUOTIENT
     downward = np.uint64(hysteresis_increment * E.HYSTERESIS_DOWNWARD_MULTIPLIER)
     upward = np.uint64(hysteresis_increment * E.HYSTERESIS_UPWARD_MULTIPLIER)
-    stale = (balances + downward < effective) | (effective + upward < balances)
+    stale = (add_u64(balances, downward) < effective) | (
+        add_u64(effective, upward) < balances
+    )
     if not stale.any():
         return
     increment = np.uint64(E.EFFECTIVE_BALANCE_INCREMENT)
     new_eff = np.minimum(
-        balances - balances % increment, np.uint64(E.MAX_EFFECTIVE_BALANCE)
+        sub_u64(balances, balances % increment),
+        np.uint64(E.MAX_EFFECTIVE_BALANCE),
     )
     stale_idx = np.nonzero(stale)[0]
     vs = state.validators
@@ -702,9 +720,12 @@ def process_effective_balance_updates(state, E, arrays=None):
         for i in stale_idx:
             mutable_validator(state, int(i)).effective_balance = int(new_eff[i])
     if arrays is not None and arrays.columns is None:
-        # legacy snapshot: update in place (resident columns re-sync
-        # from the dirty drain instead — the column may be CoW-shared)
-        arrays.effective_balance[stale_idx] = new_eff[stale_idx]
+        # legacy snapshot: update in place through the sanctioned writer
+        # (resident columns re-sync from the dirty drain instead — the
+        # column may be CoW-shared)
+        arrays.write_snapshot_rows(
+            "effective_balance", stale_idx, new_eff[stale_idx]
+        )
 
 
 def process_slashings_reset(state, E):

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..analysis import sanitizer as _san
 from ..metrics import REGISTRY
 from ..ssz.persistent import (
     PersistentByteList,
@@ -120,16 +121,39 @@ def _hash_pubkeys(pubkeys: bytes, m: int) -> np.ndarray:
     return hash_rows(rows)
 
 
-class RegistryColumns:
-    """The resident column store (see module docstring)."""
+# Column name -> the state field whose dirty channel proves it fresh
+# (the validator-struct columns all derive from the validators list).
+_SOURCE_FIELD = {
+    "balances": "balances",
+    "inactivity_scores": "inactivity_scores",
+    "previous_epoch_participation": "previous_epoch_participation",
+    "current_epoch_participation": "current_epoch_participation",
+}
 
-    __slots__ = ("_cols", "_shared", "_committed")
+
+class RegistryColumns:
+    """The resident column store (see module docstring).
+
+    Every public column property returns a READ-ONLY zero-copy view
+    (``setflags(write=False)``) in all modes: the arrays are CoW-shared
+    across state copies, so an in-place write through a view would
+    silently corrupt every aliased consumer — the only sanctioned writers
+    are `write_balances` / `write_inactivity_scores` /
+    `write_participation` (→ `_write_col`), which also commit the change
+    into the persistent lists. Under LIGHTHOUSE_TPU_SANITIZE=1 each
+    property read additionally audits the source list's dirty channel
+    (rule ``stale-read``): undrained dirt means the reader skipped
+    `refresh()` and is consuming a stale mirror."""
+
+    __slots__ = ("_cols", "_shared", "_committed", "_sources")
 
     def __init__(self):
         self._cols: dict[str, np.ndarray] = {}
         self._shared: set[str] = set()
         # source field -> the dirt token this mirror committed
         self._committed: dict[str, object] = {}
+        # source field -> the list it mirrors (sanitize-mode audit only)
+        self._sources: dict[str, object] = {}
 
     # -- copy-on-write across state copies ------------------------------
 
@@ -137,6 +161,7 @@ class RegistryColumns:
         out = RegistryColumns.__new__(RegistryColumns)
         out._cols = dict(self._cols)
         out._committed = dict(self._committed)
+        out._sources = dict(self._sources)
         shared = set(self._cols)
         out._shared = set(shared)
         self._shared |= shared
@@ -148,6 +173,11 @@ class RegistryColumns:
             arr = arr.copy()
             self._cols[name] = arr
             self._shared.discard(name)
+        elif not arr.flags.writeable:
+            # sanitize mode: a load_array product arrived frozen; the
+            # sanctioned writers own their base, so take a writable copy
+            arr = np.array(arr, copy=True)
+            self._cols[name] = arr
         return arr
 
     def _install(self, name: str, arr: np.ndarray):
@@ -156,53 +186,65 @@ class RegistryColumns:
 
     # -- column access ----------------------------------------------------
 
+    def _ro(self, name: str) -> np.ndarray | None:
+        """Read-only view of a column (None when absent), stale-audited
+        under the sanitizer."""
+        arr = self._cols.get(name)
+        if arr is None:
+            return None
+        if _san.enabled():
+            _san.audit_column_read(
+                name, self._sources.get(_SOURCE_FIELD.get(name, "validators"))
+            )
+        return _san.freeze_view(arr)
+
     @property
     def effective_balance(self) -> np.ndarray:
-        return self._cols["effective_balance"]
+        return self._ro("effective_balance")
 
     @property
     def activation_eligibility_epoch(self) -> np.ndarray:
-        return self._cols["activation_eligibility_epoch"]
+        return self._ro("activation_eligibility_epoch")
 
     @property
     def activation_epoch(self) -> np.ndarray:
-        return self._cols["activation_epoch"]
+        return self._ro("activation_epoch")
 
     @property
     def exit_epoch(self) -> np.ndarray:
-        return self._cols["exit_epoch"]
+        return self._ro("exit_epoch")
 
     @property
     def withdrawable_epoch(self) -> np.ndarray:
-        return self._cols["withdrawable_epoch"]
+        return self._ro("withdrawable_epoch")
 
     @property
     def slashed(self) -> np.ndarray:
-        return self._cols["slashed"]
+        return self._ro("slashed")
 
     @property
     def withdrawal_credentials(self) -> np.ndarray:
-        return self._cols["withdrawal_credentials"]
+        return self._ro("withdrawal_credentials")
 
     @property
     def pubkey_root(self) -> np.ndarray:
-        return self._cols["pubkey_root"]
+        return self._ro("pubkey_root")
 
     @property
     def balances(self) -> np.ndarray:
-        return self._cols["balances"]
+        return self._ro("balances")
 
     @property
     def inactivity_scores(self) -> np.ndarray | None:
-        return self._cols.get("inactivity_scores")
+        return self._ro("inactivity_scores")
 
     @property
     def previous_epoch_participation(self) -> np.ndarray | None:
-        return self._cols.get("previous_epoch_participation")
+        return self._ro("previous_epoch_participation")
 
     @property
     def current_epoch_participation(self) -> np.ndarray | None:
-        return self._cols.get("current_epoch_participation")
+        return self._ro("current_epoch_participation")
 
     @property
     def validator_count(self) -> int:
@@ -303,6 +345,8 @@ class RegistryColumns:
                 col = self._grow(field, n)
                 col[idx] = [lst[int(i)] for i in idx]
         self._committed[field] = lst.dirt_token_for(COLUMNS_CHANNEL)
+        if _san.enabled():
+            self._sources[field] = lst
 
     def _refresh_validators(self, lst: PersistentContainerList):
         n = len(lst)
@@ -361,6 +405,8 @@ class RegistryColumns:
                 )
         # sync the "validators" marker column used for size bookkeeping
         self._committed["validators"] = lst.dirt_token_for(COLUMNS_CHANNEL)
+        if _san.enabled():
+            self._sources["validators"] = lst
 
     def _rebuild_validators(self, lst: PersistentContainerList):
         n = len(lst)
@@ -456,6 +502,7 @@ class RegistryColumns:
         directly, so the steady-state epoch still rebuilds ZERO columns."""
         cur_col = self._cols.pop("current_epoch_participation", None)
         cur_tok = self._committed.pop("current_epoch_participation", None)
+        cur_src = self._sources.pop("current_epoch_participation", None)
         if cur_col is not None:
             self._cols["previous_epoch_participation"] = cur_col
             if "current_epoch_participation" in self._shared:
@@ -463,6 +510,8 @@ class RegistryColumns:
             else:
                 self._shared.discard("previous_epoch_participation")
             self._committed["previous_epoch_participation"] = cur_tok
+            if cur_src is not None:
+                self._sources["previous_epoch_participation"] = cur_src
         fresh = getattr(state, "current_epoch_participation", None)
         if isinstance(fresh, PersistentByteList):
             self._install(
@@ -472,9 +521,12 @@ class RegistryColumns:
             self._committed["current_epoch_participation"] = (
                 fresh.dirt_token_for(COLUMNS_CHANNEL)
             )
+            if _san.enabled():
+                self._sources["current_epoch_participation"] = fresh
         else:
             self._cols.pop("current_epoch_participation", None)
             self._committed.pop("current_epoch_participation", None)
+            self._sources.pop("current_epoch_participation", None)
 
     # -- element roots for the hash caches -------------------------------
 

@@ -29,6 +29,7 @@ def pytest_sessionstart(session):
     """Tier-1 guard: the BLS verification caches must export hit/miss
     counters through the metrics registry (the bench JSON and /metrics
     consumers rely on the series existing even at zero)."""
+    from lighthouse_tpu.analysis import sanitizer  # noqa: F401 — registers
     from lighthouse_tpu.crypto import bls  # noqa: F401 — registers counters
     from lighthouse_tpu.metrics import REGISTRY
     from lighthouse_tpu.network import sync  # noqa: F401 — registers sync series
@@ -90,6 +91,12 @@ def pytest_sessionstart(session):
         'registry_columns_row_writebacks_total{field="previous_epoch_participation"}',
         'registry_columns_row_writebacks_total{field="current_epoch_participation"}',
         "trace_span_seconds_attestation_apply",
+        # PR 8: the beacon-san runtime sanitizer's violation counters must
+        # exist at zero for every rule (dashboards and the sanitize soak
+        # read them eagerly)
+        'sanitizer_violations_total{rule="cow-write"}',
+        'sanitizer_violations_total{rule="u64-wrap"}',
+        'sanitizer_violations_total{rule="stale-read"}',
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"

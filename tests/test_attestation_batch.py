@@ -527,7 +527,8 @@ def test_persistent_byte_list_cow_and_dirty_channels():
     _, dirty2 = lst.drain_dirty()
     assert dirty2 == set()
     # store_array marks exactly the changed rows in the named channel
-    arr = lst.load_array()
+    # (stage into a copy: load_array views are read-only under beacon-san)
+    arr = lst.load_array().copy()
     arr[100] = 42
     lst.channel("columns")
     lst.store_array(arr)

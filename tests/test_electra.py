@@ -164,6 +164,38 @@ def test_el_withdrawal_request_partial_compounding():
     assert withdrawals[0].amount == 2_000_000_000
 
 
+def test_multiple_pending_partials_cap_against_remaining_excess():
+    """Several matured queue entries for ONE validator: each must be
+    capped against the balance REMAINING after the withdrawals already
+    produced this sweep (spec total_withdrawn deduction) — a per-entry
+    cap against the undecremented balance would overdraw the validator
+    and blow the stage-2 sweep's balance arithmetic."""
+    spec = electra_spec()
+    st = _genesis(spec)
+    addr = b"\xdd" * 20
+    v = st.validators[4]
+    v.withdrawal_credentials = b"\x02" + b"\x00" * 11 + addr
+    v.effective_balance = spec.min_activation_balance
+    excess = 5_000_000_000
+    st.balances[4] = spec.min_activation_balance + excess
+    # three matured 3 ETH requests against 5 ETH of excess
+    for _ in range(3):
+        st.pending_partial_withdrawals.append(
+            T.PendingPartialWithdrawal(
+                index=4, amount=3_000_000_000, withdrawable_epoch=0
+            )
+        )
+    st.slot = 2 * E.SLOTS_PER_EPOCH
+    withdrawals, partials = EL.get_expected_withdrawals_electra(st, spec, E)
+    assert partials == 3
+    mine = [w for w in withdrawals if w.validator_index == 4]
+    # 3 + 2 + 0: the third entry sees no remaining excess
+    assert [w.amount for w in mine] == [3_000_000_000, 2_000_000_000]
+    assert sum(w.amount for w in mine) == excess
+    # the same call runs the stage-2 sweep over the decremented
+    # balances — reaching here proves its safe_sub stayed in range
+
+
 def test_pending_consolidations_transfer_balance():
     spec = electra_spec()
     st = _genesis(spec)

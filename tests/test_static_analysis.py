@@ -1,0 +1,373 @@
+"""beacon-san linter: per-rule firing/non-firing fixtures, suppression
+syntax, and the tier-1 whole-package cleanliness gate.
+
+Every rule family ships one known-bad snippet that MUST fire and one
+known-good snippet that MUST NOT (the linter's own regression suite),
+and `test_package_is_lint_clean` runs the linter over the entire
+`lighthouse_tpu/` package — a new unsuppressed violation anywhere in the
+tree fails tier-1, which is what makes the invariants enforced rather
+than documented."""
+
+from pathlib import Path
+
+import pytest
+
+from lighthouse_tpu.analysis import lint_paths, lint_source, main
+from lighthouse_tpu.analysis.lint import RULES
+
+PKG = Path(__file__).resolve().parent.parent / "lighthouse_tpu"
+
+# a synthetic path inside state_processing/ (the safe-arith rule's scope)
+SP = "lighthouse_tpu/state_processing/_fixture.py"
+# a synthetic path outside it
+OUT = "lighthouse_tpu/network/_fixture.py"
+
+
+def _rules(violations):
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------------------------
+# safe-arith
+# ---------------------------------------------------------------------------
+
+
+def test_safe_arith_fires_on_raw_state_arithmetic():
+    bad = (
+        "def f(state, index, E):\n"
+        "    return state.validators[index].effective_balance // E.INC\n"
+    )
+    assert _rules(lint_source(bad, SP)) == ["safe-arith"]
+
+
+def test_safe_arith_fires_through_name_taint():
+    bad = (
+        "def f(state, i, fee):\n"
+        "    balance = state.balances[i]\n"
+        "    return balance - fee\n"
+    )
+    assert _rules(lint_source(bad, SP)) == ["safe-arith"]
+
+
+def test_safe_arith_fires_on_augassign_and_load_products():
+    bad = (
+        "def f(state, arrays, rewards):\n"
+        "    balances = arrays.load_balances(state)\n"
+        "    balances += rewards\n"
+        "    return balances\n"
+    )
+    assert _rules(lint_source(bad, SP)) == ["safe-arith"]
+
+
+def test_safe_arith_clean_when_routed_through_helpers():
+    good = (
+        "from lighthouse_tpu.utils.safe_arith import safe_div, safe_sub\n"
+        "def f(state, index, E, fee):\n"
+        "    inc = safe_div(state.validators[index].effective_balance, E.INC)\n"
+        "    balance = state.balances[index]\n"
+        "    return safe_sub(balance, fee) + inc\n"
+    )
+    assert lint_source(good, SP) == []
+
+
+def test_safe_arith_scoped_to_state_processing():
+    outside = (
+        "def f(state, index, E):\n"
+        "    return state.validators[index].effective_balance // E.INC\n"
+    )
+    assert lint_source(outside, OUT) == []
+
+
+# ---------------------------------------------------------------------------
+# cow-aliasing
+# ---------------------------------------------------------------------------
+
+
+def test_cow_aliasing_fires_on_load_array_write():
+    bad = (
+        "def f(lst, idx):\n"
+        "    arr = lst.load_array()\n"
+        "    arr[idx] = 0\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["cow-aliasing"]
+
+
+def test_cow_aliasing_fires_on_committee_slice_and_column_views():
+    bad = (
+        "def f(cc, cols, slot, index):\n"
+        "    committee = cc.committee_array(slot, index)\n"
+        "    committee[0] = 7\n"
+        "    cols.effective_balance[3] = 1\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["cow-aliasing", "cow-aliasing"]
+
+
+def test_cow_aliasing_fires_on_setflags_reenable():
+    bad = (
+        "def f(lst):\n"
+        "    arr = lst.load_array()\n"
+        "    arr.setflags(write=True)\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["cow-aliasing"]
+
+
+def test_cow_aliasing_fires_on_self_attr_views_across_methods():
+    bad = (
+        "class T:\n"
+        "    def __init__(self, lst):\n"
+        "        self.read = lst.load_array()\n"
+        "    def commit(self, i, v):\n"
+        "        self.read[i] = v\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["cow-aliasing"]
+
+
+def test_cow_aliasing_clean_when_copied_first():
+    good = (
+        "def f(lst, idx):\n"
+        "    arr = lst.load_array().copy()\n"
+        "    arr[idx] = 0\n"
+        "    lst.store_array(arr)\n"
+    )
+    assert lint_source(good, OUT) == []
+
+
+# ---------------------------------------------------------------------------
+# fork-safety
+# ---------------------------------------------------------------------------
+
+
+def test_fork_safety_fires_on_metrics_in_worker():
+    bad = (
+        "from ..metrics import inc_counter\n"
+        "def _worker(task):\n"
+        "    inc_counter('tasks')\n"
+        "    return task * 2\n"
+        "def run(pool, tasks):\n"
+        "    return pool.map(_worker, tasks)\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["fork-safety"]
+
+
+def test_fork_safety_fires_through_same_module_callees():
+    bad = (
+        "import logging\n"
+        "def _inner(x):\n"
+        "    logging.info(x)\n"
+        "    return x\n"
+        "def _worker(task):\n"
+        "    return _inner(task)\n"
+        "def run(pool, tasks):\n"
+        "    return pool.map(_worker, tasks)\n"
+    )
+    assert "fork-safety" in _rules(lint_source(bad, OUT))
+
+
+def test_fork_safety_fires_on_lambda():
+    bad = (
+        "def run(pool, tasks):\n"
+        "    return pool.map(lambda t: t, tasks)\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["fork-safety"]
+
+
+def test_fork_safety_resolves_workers_across_one_import_hop(tmp_path):
+    """`pool.map(worker, ...)` where `worker` is imported from a sibling
+    module: the linter must follow the ImportFrom and scan the worker in
+    its home module (how crypto/bls submits pairing.miller_product)."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "workers.py").write_text(
+        "from .metrics import inc_counter\n"
+        "def tally_chunk(chunk):\n"
+        "    inc_counter('chunks')\n"
+        "    return chunk\n"
+    )
+    (pkg / "metrics.py").write_text("def inc_counter(name): pass\n")
+    caller = pkg / "caller.py"
+    caller.write_text(
+        "from .workers import tally_chunk\n"
+        "def run(pool, tasks):\n"
+        "    return pool.map(tally_chunk, tasks)\n"
+    )
+    violations = lint_paths([caller])
+    assert _rules(violations) == ["fork-safety"]
+    assert "inc_counter" in violations[0].message
+
+
+def test_fork_safety_resolves_absolute_imports(tmp_path):
+    """`from pkg.workers import f` (level=0): the resolver must ascend
+    to the directory holding the top-level package instead of joining
+    the full dotted path onto the caller's own directory (which yields
+    a nonexistent pkg/sub/pkg/... path and silently skips the scan)."""
+    pkg = tmp_path / "pkg"
+    sub = pkg / "sub"
+    sub.mkdir(parents=True)
+    for d in (pkg, sub):
+        (d / "__init__.py").write_text("")
+    (pkg / "workers.py").write_text(
+        "import logging\n"
+        "def tally(chunk):\n"
+        "    logging.info(chunk)\n"
+        "    return chunk\n"
+    )
+    caller = sub / "caller.py"
+    caller.write_text(
+        "from pkg.workers import tally\n"
+        "def run(pool, tasks):\n"
+        "    return pool.map(tally, tasks)\n"
+    )
+    violations = lint_paths([caller])
+    assert _rules(violations) == ["fork-safety"]
+    assert "logging" in violations[0].message
+
+
+def test_fork_safety_clean_for_pure_worker():
+    good = (
+        "def _worker(task):\n"
+        "    return sum(task) * 2\n"
+        "def run(pool, tasks):\n"
+        "    return pool.map(_worker, tasks)\n"
+    )
+    assert lint_source(good, OUT) == []
+
+
+def test_fork_safety_audit_of_real_pool_workers():
+    """The PR 8 satellite audit, pinned as a test: every callable the BLS
+    batch verifier submits to the fork pool (_prep_chunk, _hash_g2_chunk,
+    _msm_chunk, pairing.miller_product via the import hop) must stay
+    lock-free — no metrics, logging, spans, jax, or locks anywhere in
+    their same-module call graphs."""
+    targets = [
+        PKG / "crypto" / "bls" / "__init__.py",
+        PKG / "parallel" / "host_pool.py",
+    ]
+    violations = [v for v in lint_paths(targets) if v.rule == "fork-safety"]
+    assert violations == [], "\n".join(v.render() for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# dirty-channel
+# ---------------------------------------------------------------------------
+
+
+def test_dirty_channel_fires_on_inline_literal():
+    bad = "def f(lst):\n    return lst.drain_dirty('columns')\n"
+    assert _rules(lint_source(bad, OUT)) == ["dirty-channel"]
+
+
+def test_dirty_channel_fires_on_unregistered_constant():
+    bad = (
+        "CH = 'columns'\n"
+        "def f(lst):\n"
+        "    return lst.drain_dirty(CH)\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["dirty-channel"]
+
+
+def test_dirty_channel_clean_when_registered():
+    good = (
+        "CH = 'columns'\n"
+        "def f(lst):\n"
+        "    base, dirty = lst.drain_dirty(CH)\n"
+        "    token = lst.dirt_token_for(CH)\n"
+        "    return base, dirty, token\n"
+    )
+    assert lint_source(good, OUT) == []
+
+
+def test_dirty_channel_fires_on_handle_write_after_drain():
+    bad = (
+        "def f(state, lst, i, E):\n"
+        "    v = lst.mutate(i)\n"
+        "    total = get_total_active_balance(state, E)\n"
+        "    v.exit_epoch = total\n"
+    )
+    assert _rules(lint_source(bad, SP)) == ["dirty-channel"]
+
+
+def test_dirty_channel_clean_when_reads_precede_handle():
+    good = (
+        "def f(state, lst, i, E):\n"
+        "    total = get_total_active_balance(state, E)\n"
+        "    v = lst.mutate(i)\n"
+        "    v.exit_epoch = total\n"
+    )
+    assert lint_source(good, SP) == []
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_line_suppression_with_reason_is_honored():
+    src = (
+        "def f(lst, idx):\n"
+        "    arr = lst.load_array()\n"
+        "    # lint: allow(cow-aliasing) -- test fixture stages in place\n"
+        "    arr[idx] = 0\n"
+    )
+    assert lint_source(src, OUT) == []
+
+
+def test_suppression_without_reason_is_a_violation():
+    src = (
+        "def f(lst, idx):\n"
+        "    arr = lst.load_array()\n"
+        "    arr[idx] = 0  # lint: allow(cow-aliasing)\n"
+    )
+    rules = _rules(lint_source(src, OUT))
+    assert "suppression" in rules and "cow-aliasing" in rules
+
+
+def test_file_level_suppression():
+    src = (
+        "# lint: allow-file(cow-aliasing) -- fixture module\n"
+        "def f(lst, idx):\n"
+        "    arr = lst.load_array()\n"
+        "    arr[idx] = 0\n"
+    )
+    assert lint_source(src, OUT) == []
+
+
+def test_unknown_rule_in_suppression_is_flagged():
+    src = "x = 1  # lint: allow(made-up-rule) -- whatever\n"
+    assert _rules(lint_source(src, OUT)) == ["suppression"]
+
+
+def test_allow_syntax_in_strings_does_not_count():
+    src = (
+        'DOC = "# lint: allow(cow-aliasing) -- not a comment"\n'
+        "def f(lst, idx):\n"
+        "    arr = lst.load_array()\n"
+        "    arr[idx] = 0\n"
+    )
+    assert _rules(lint_source(src, OUT)) == ["cow-aliasing"]
+
+
+# ---------------------------------------------------------------------------
+# Whole-package gate + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_package_is_lint_clean():
+    """Tier-1 gate: `python -m lighthouse_tpu.analysis lighthouse_tpu/`
+    must exit 0 — any new unsuppressed violation fails the suite."""
+    violations = lint_paths([PKG])
+    assert violations == [], "\n".join(v.render() for v in violations)
+
+
+def test_cli_entrypoint(capsys):
+    assert main(["--list-rules", str(PKG)]) == 0
+    assert set(capsys.readouterr().out.split()) == set(RULES)
+    assert main([str(PKG)]) == 0
+
+
+def test_cli_reports_violations(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(lst, i):\n    a = lst.load_array()\n    a[i] = 1\n")
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "cow-aliasing" in out and "bad.py:3" in out
