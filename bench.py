@@ -1003,6 +1003,62 @@ def bench_attestation_batch(jax):
     }
 
 
+def _hist_percentiles(buckets, counts, qs=(0.5, 0.9, 0.99)):
+    """Approximate quantiles from cumulative histogram buckets (linear
+    interpolation inside the landing bucket; the +Inf bucket reports the
+    last finite bound). `counts` is per-bucket (non-cumulative)."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    out = {}
+    for q in qs:
+        target = q * total
+        cum = 0.0
+        value = buckets[-1]
+        for i, c in enumerate(counts):
+            prev_cum = cum
+            cum += c
+            if cum >= target:
+                lo = 0.0 if i == 0 else buckets[i - 1]
+                hi = buckets[i] if i < len(buckets) else buckets[-1]
+                frac = (target - prev_cum) / c if c else 1.0
+                value = lo + (hi - lo) * frac
+                break
+        out[f"p{int(q * 100)}_ms"] = round(value * 1000.0, 3)
+    out["count"] = total
+    return out
+
+
+def _queue_wait_snapshot():
+    """Per-WorkType (buckets, counts) of the beacon_processor time-in-queue
+    histograms — PR 9's queue observability, consumed as before/after
+    deltas so the bench reports only ITS OWN queue waits."""
+    from lighthouse_tpu.beacon_processor import WorkType
+    from lighthouse_tpu.metrics import REGISTRY
+
+    out = {}
+    for t in WorkType:
+        kind = t.name.lower()
+        buckets, counts, _total, _sum = REGISTRY.histogram(
+            f"beacon_processor_queue_wait_seconds_{kind}"
+        ).snapshot()
+        out[kind] = (buckets, counts)
+    return out
+
+
+def _queue_wait_percentiles(before, after):
+    """kind -> {p50_ms, p90_ms, p99_ms, count} for every WorkType whose
+    queue saw traffic between the two snapshots."""
+    out = {}
+    for kind, (buckets, counts) in after.items():
+        b_counts = before.get(kind, (buckets, [0] * len(counts)))[1]
+        delta = [a - b for a, b in zip(counts, b_counts)]
+        p = _hist_percentiles(buckets, delta)
+        if p is not None:
+            out[kind] = p
+    return out
+
+
 def bench_sync_catchup(jax):
     """Sync-engine catch-up rate: blocks/sec for a fresh node pulling N
     slots from a loopback peer through the batch state machine
@@ -1060,11 +1116,13 @@ def bench_sync_catchup(jax):
         }
 
     before = counters()
+    queue_before = _queue_wait_snapshot()
     engine, serial = [], []
     for i in range(3):
         engine.append(one_catchup("sync_with"))
         _partial(trial=i + 1, of=3, s=round(engine[-1], 4))
     after = counters()
+    queue_wait = _queue_wait_percentiles(queue_before, _queue_wait_snapshot())
     for i in range(3):
         serial.append(one_catchup("sequential_sync_with"))
         _partial(control_trial=i + 1, of=3, s=round(serial[-1], 4))
@@ -1079,6 +1137,10 @@ def bench_sync_catchup(jax):
         "baseline_control": "pre-engine sequential single-peer sync loop, same run",
         "config": {"slots": slots, "validators": 16, "spec": "minimal"},
         "counters": {k: after[k] - before[k] for k in after},
+        # PR 9 queue observability: time-in-queue percentiles per WorkType
+        # across the engine trials (chain_segment is the sync lane) — the
+        # backpressure number the blocks/sec headline can't show
+        "queue_wait": queue_wait,
         "spread": spread(engine),
         "control_spread": spread(serial),
     }

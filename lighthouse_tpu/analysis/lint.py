@@ -49,7 +49,13 @@ import re
 from dataclasses import dataclass
 from pathlib import Path
 
-RULES = ("safe-arith", "cow-aliasing", "fork-safety", "dirty-channel")
+RULES = (
+    "safe-arith",
+    "cow-aliasing",
+    "fork-safety",
+    "dirty-channel",
+    "metric-hygiene",
+)
 
 _ALLOW_RE = re.compile(
     r"#\s*lint:\s*(allow|allow-file)\(([a-z\-,\s]+)\)(?:\s*--\s*(\S.*))?"
@@ -107,6 +113,21 @@ _FORBIDDEN_WORKER_NAMES = {
     "Lock": "a lock",
     "RLock": "a lock",
 }
+
+# -- metric-hygiene vocabulary -----------------------------------------------
+
+#: helpers whose FIRST positional argument is a metric/span name
+_METRIC_NAME_CALLS = {
+    "span",
+    "traced",
+    "inc_counter",
+    "set_gauge",
+    "observe",
+    "set_distribution",
+    "start_timer",
+}
+#: registry methods whose first argument is a collector name
+_REGISTRY_METHODS = {"counter", "gauge", "histogram"}
 
 # -- dirty-channel vocabulary ------------------------------------------------
 
@@ -699,6 +720,80 @@ def _check_dirty_channel(tree: ast.Module, path: str) -> list[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# Rule: metric-hygiene
+# ---------------------------------------------------------------------------
+
+
+def _is_registry_receiver(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in ("REGISTRY", "registry")
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("REGISTRY", "registry")
+    return False
+
+
+def _check_metric_hygiene(tree: ast.Module, path: str) -> list[Violation]:
+    """Span/metric names must be string literals or module-level
+    constants: a runtime-dynamic name (f-string, local, attribute) mints
+    an unbounded family of histogram series in the registry AND an
+    unbounded `tracing._last_logged` rate-limit map — series-cardinality
+    explosion, the classic Prometheus foot-gun."""
+    out: list[Violation] = []
+
+    # names bindable at module scope: assignments and imports both count
+    # as "module-level constant" (shared NAME constants are often
+    # imported from the module that registers the series)
+    consts: set[str] = set()
+    for n in tree.body:
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    consts.add(t.id)
+        elif isinstance(n, ast.AnnAssign) and isinstance(n.target, ast.Name):
+            consts.add(n.target.id)
+        elif isinstance(n, (ast.Import, ast.ImportFrom)):
+            for alias in n.names:
+                consts.add((alias.asname or alias.name).split(".")[0])
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _METRIC_NAME_CALLS:
+            helper = f.id
+        elif (
+            isinstance(f, ast.Attribute)
+            and f.attr in _REGISTRY_METHODS
+            and _is_registry_receiver(f.value)
+        ):
+            helper = f"REGISTRY.{f.attr}"
+        else:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            continue
+        if isinstance(arg, ast.Name) and arg.id in consts:
+            continue
+        what = (
+            "an f-string"
+            if isinstance(arg, ast.JoinedStr)
+            else type(arg).__name__
+        )
+        out.append(
+            Violation(
+                path,
+                getattr(arg, "lineno", node.lineno),
+                "metric-hygiene",
+                f"dynamic metric/span name ({what}) passed to {helper}() — "
+                f"use a string literal or a module-level constant; dynamic "
+                f"names explode series cardinality and grow "
+                f"tracing._last_logged unboundedly",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------
 
@@ -707,6 +802,7 @@ _CHECKS = (
     _check_cow_aliasing,
     _check_fork_safety,
     _check_dirty_channel,
+    _check_metric_hygiene,
 )
 
 

@@ -12,11 +12,31 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ..crypto import bls
+from ..metrics import REGISTRY
 from ..state_processing import signature_sets as sigsets
 from ..state_processing.accessors import (
     committee_cache_at,
     compute_epoch_at_slot,
     get_attesting_indices,
+)
+
+# Slot-anchored observation delays (the reference's
+# beacon_attestation_gossip_slot_start_delay_time family): how far into
+# an attestation's slot it reached US — the input-side latency number the
+# import/queue metrics can't see. Buckets span a slot-and-change: the
+# propagation window allows attestations several slots old.
+_OBS_DELAY_BUCKETS = (
+    0.25, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 24.0, 48.0, 96.0,
+)
+_ATT_OBS_DELAY = REGISTRY.histogram(
+    "beacon_attestation_gossip_slot_start_delay_seconds",
+    "attestation slot start → gossip verification reached it",
+    buckets=_OBS_DELAY_BUCKETS,
+)
+_AGG_OBS_DELAY = REGISTRY.histogram(
+    "beacon_aggregate_gossip_slot_start_delay_seconds",
+    "aggregate's slot start → gossip verification reached it",
+    buckets=_OBS_DELAY_BUCKETS,
 )
 
 
@@ -77,6 +97,12 @@ class AttestationVerifier:
         signature set). Signature NOT yet verified."""
         data = attestation.data
         self._common_checks(data)
+        # clamped at 0: clock disparity lets an attestation arrive just
+        # before its slot starts — a negative sample would corrupt the
+        # histogram's bucket counts and sum
+        _ATT_OBS_DELAY.observe(
+            max(0.0, self.chain.slot_clock.slot_offset_seconds(int(data.slot)))
+        )
         if sum(attestation.aggregation_bits) != 1:
             raise AttestationError("unaggregated attestation must set one bit")
         state = self._indexing_state(data)
@@ -164,6 +190,9 @@ class AttestationVerifier:
         aggregate = message.aggregate
         data = aggregate.data
         self._common_checks(data)
+        _AGG_OBS_DELAY.observe(  # clamped: see batch_verify_unaggregated
+            max(0.0, self.chain.slot_clock.slot_offset_seconds(int(data.slot)))
+        )
         if sum(aggregate.aggregation_bits) == 0:
             raise AttestationError("empty aggregate")
         state = self._indexing_state(data)

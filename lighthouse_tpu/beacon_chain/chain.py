@@ -118,7 +118,9 @@ class BeaconChain:
 
         self.event_handler = ServerSentEventHandler()
         self.validator_monitor = ValidatorMonitor(E)
-        self.block_times_cache = BlockTimesCache()
+        self.block_times_cache = BlockTimesCache(
+            slot_clock=slot_clock, seconds_per_slot=spec.seconds_per_slot
+        )
         self.state_advance_cache = StateAdvanceCache()
         self.invalid_block_roots: set[bytes] = set()
         self._last_finalized_epoch_seen = 0
@@ -416,6 +418,11 @@ class BeaconChain:
             raise BlockError(f"future block (slot {block.slot} > {current_slot})")
         if self.fork_choice.contains_block(block_root):
             raise BlockError("block already known")
+        # first observation milestone: the gossip hop is where block
+        # lateness originates, so stamp before any verification work
+        self.block_times_cache.set_observed(
+            block_root, block.slot, time.monotonic()
+        )
         if not self.fork_choice.contains_block(block.parent_root):
             raise BlockError("parent unknown")
         finalized_slot = compute_start_slot_at_epoch(
@@ -431,6 +438,9 @@ class BeaconChain:
         ).verify():
             raise BlockError("invalid proposer signature")
         self.observed_block_producers.observe(block.slot, block.proposer_index)
+        self.block_times_cache.set_gossip_verified(
+            block_root, block.slot, time.monotonic()
+        )
         return GossipVerifiedBlock(
             signed_block=signed_block, block_root=block_root, pre_state=parent_state
         )
@@ -532,14 +542,20 @@ class BeaconChain:
                 )
             imported_blobs = avail.blobs
 
+        def _milestone(name, _root=block_root, _slot=block.slot):
+            self.block_times_cache.stamp(name, _root, _slot, time.monotonic())
+
         ctxt = ConsensusContext(block.slot)
         if (
             precomputed_post_state is not None
             and block_root in segment_verified_roots
         ):
             # segment path: signatures batch-verified, transition already
-            # run (state root checked) and EL notified during the replay
+            # run (state root checked) and EL notified during the replay —
+            # both pipeline milestones are behind us, stamp them now
             state = precomputed_post_state
+            _milestone("signature_verified")
+            _milestone("payload_verified")
         else:
             state = (
                 pre_state if pre_state is not None else self._pre_state_for(block)
@@ -561,6 +577,7 @@ class BeaconChain:
                         block_root=block_root,
                         proposal_already_verified=proposal_verified,
                         execution_engine=self.execution_layer,
+                        milestones=_milestone,
                     )
             except BlockProcessingError as e:
                 raise BlockError(f"invalid block: {e}") from e
@@ -904,16 +921,19 @@ class BeaconChain:
             raise BlockError(f"blob sidecars rejected: {e}") from e
 
     def process_attestation_batch(self, attestations) -> list:
-        results = self.attestation_verifier.batch_verify_unaggregated(
-            attestations
-        )
-        with self.import_lock.acquire_write():
-            for att, res in zip(attestations, results):
-                if not isinstance(res, Exception):
-                    self.apply_attestation_to_fork_choice(
-                        res.indexed_attestation
-                    )
-                    self.op_pool.insert_attestation(att)
+        # root span of the gossip-attestation hot path (OBSERVABILITY.md
+        # taxonomy): verification + fork-choice application as one trace
+        with span("attestation_batch", n=len(attestations)):
+            results = self.attestation_verifier.batch_verify_unaggregated(
+                attestations
+            )
+            with self.import_lock.acquire_write():
+                for att, res in zip(attestations, results):
+                    if not isinstance(res, Exception):
+                        self.apply_attestation_to_fork_choice(
+                            res.indexed_attestation
+                        )
+                        self.op_pool.insert_attestation(att)
         return results
 
     def process_aggregate(self, signed_aggregate):

@@ -15,6 +15,11 @@ from ..utils.logging import get_logger
 
 log = get_logger("validator_monitor")
 
+#: retained per-validator inclusion-delay window (slots). Doubles as the
+#: duplicate-inclusion dedup horizon — a long soak previously grew the
+#: dict one entry per attested slot, forever.
+MAX_INCLUSION_DELAY_SLOTS = 64
+
 
 @dataclass
 class MonitoredValidator:
@@ -23,10 +28,21 @@ class MonitoredValidator:
     blocks_proposed: int = 0
     attestations_included: int = 0
     attestations_missed: int = 0
-    #: slot -> inclusion delay for included attestations
+    #: slot -> inclusion delay, bounded to the last
+    #: MAX_INCLUSION_DELAY_SLOTS distinct attested slots (insertion order)
     inclusion_delays: dict = field(default_factory=dict)
-    #: epochs in which we saw an attestation included
+    #: epochs in which we saw an attestation included (pruned at rollover)
     attested_epochs: set = field(default_factory=set)
+
+    def record_inclusion(self, slot: int, delay: int) -> bool:
+        """True if this slot's inclusion is new (first block to include
+        the vote wins, as the reference credits best-inclusion)."""
+        if slot in self.inclusion_delays:
+            return False
+        self.inclusion_delays[slot] = delay
+        while len(self.inclusion_delays) > MAX_INCLUSION_DELAY_SLOTS:
+            self.inclusion_delays.pop(next(iter(self.inclusion_delays)))
+        return True
 
 
 class ValidatorMonitor:
@@ -69,7 +85,7 @@ class ValidatorMonitor:
             )
 
         from ..state_processing.accessors import (
-            committee_cache_at,
+            attesting_indices_array,
             compute_epoch_at_slot,
         )
 
@@ -77,30 +93,33 @@ class ValidatorMonitor:
             data = att.data
             epoch = compute_epoch_at_slot(data.slot, self.E)
             try:
-                cc = committee_cache_at(state, epoch, self.E)
-                committee = cc.committee(data.slot, data.index)
+                # PR 7's shared columnar source: one vectorized gather
+                # over the committee permutation instead of a Python walk
+                # of every committee position per attestation
+                attesters = attesting_indices_array(
+                    state, data, att.aggregation_bits, self.E
+                )
             except Exception:  # noqa: BLE001 — cross-epoch edge; skip credit
                 continue
-            bits = att.aggregation_bits
-            for pos, vi in enumerate(committee):
-                if pos < len(bits) and bits[pos] and self.auto_register:
+            if self.auto_register:
+                for vi in attesters.tolist():
                     self.add_validator(vi)  # --validator-monitor-auto
-                if pos < len(bits) and bits[pos] and vi in self._by_index:
-                    mv = self._by_index[vi]
-                    delay = max(1, block.slot - data.slot)
-                    if data.slot not in mv.inclusion_delays:
-                        mv.attestations_included += 1
-                        mv.inclusion_delays[data.slot] = delay
-                        mv.attested_epochs.add(epoch)
-                        inc_counter(
-                            "validator_monitor_attestations_included_total"
-                        )
-                        log.info(
-                            "monitored validator attestation included",
-                            validator=vi,
-                            slot=data.slot,
-                            delay=delay,
-                        )
+            if not self._by_index:
+                continue
+            delay = max(1, block.slot - data.slot)
+            for vi in attesters.tolist():
+                mv = self._by_index.get(vi)
+                if mv is None or not mv.record_inclusion(int(data.slot), delay):
+                    continue
+                mv.attestations_included += 1
+                mv.attested_epochs.add(epoch)
+                inc_counter("validator_monitor_attestations_included_total")
+                log.info(
+                    "monitored validator attestation included",
+                    validator=vi,
+                    slot=data.slot,
+                    delay=delay,
+                )
 
     def process_epoch_rollover(self, completed_epoch: int):
         """Called once per completed epoch: any monitored validator with no
@@ -118,4 +137,10 @@ class ValidatorMonitor:
                     validator=mv.index,
                     epoch=completed_epoch,
                 )
+            # summarized epochs never get re-checked: keep a short
+            # retention window for operator queries, drop the rest so the
+            # set stays bounded on a long soak (mirrors inclusion_delays)
+            mv.attested_epochs = {
+                e for e in mv.attested_epochs if e >= completed_epoch - 4
+            }
         set_gauge("validator_monitor_validators", len(self._by_index))

@@ -15,13 +15,15 @@ batches into the chain's batch entry points.
 
 from __future__ import annotations
 
+import contextvars
 import enum
 import queue
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
-from ..metrics import inc_counter, set_gauge
+from ..metrics import REGISTRY, inc_counter, set_gauge
 
 MAX_GOSSIP_ATTESTATION_BATCH_SIZE = 64
 MAX_GOSSIP_AGGREGATE_BATCH_SIZE = 64
@@ -61,6 +63,56 @@ _BATCHED = {
     WorkType.GOSSIP_AGGREGATE: MAX_GOSSIP_AGGREGATE_BATCH_SIZE,
 }
 
+# Queue observability (the reference's beacon_processor_* metric family):
+# time-in-queue and handler-run histograms per WorkType, eagerly
+# registered so the series exist at zero for bench/dashboard consumers.
+# Queue waits reach seconds under backpressure; the run histograms keep
+# the default sub-second buckets.
+_QUEUE_WAIT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0,
+)
+_QUEUE_WAIT = {
+    t: REGISTRY.histogram(
+        # registered eagerly at import (not a runtime-dynamic name):
+        # lint: allow(metric-hygiene) -- bounded by the WorkType enum
+        f"beacon_processor_queue_wait_seconds_{t.name.lower()}",
+        f"time from submit to worker pickup: {t.name.lower()}",
+        buckets=_QUEUE_WAIT_BUCKETS,
+    )
+    for t in WorkType
+}
+_HANDLER_RUN = {
+    t: REGISTRY.histogram(
+        # lint: allow(metric-hygiene) -- bounded by the WorkType enum
+        f"beacon_processor_work_seconds_{t.name.lower()}",
+        f"handler wall time per drained batch: {t.name.lower()}",
+    )
+    for t in WorkType
+}
+for _t in WorkType:
+    # distinct name from the unlabelled total: mixing labelled and
+    # unlabelled series under one gauge would double-count on sum()
+    set_gauge("beacon_processor_queue_depth_by_kind", 0, kind=_t.name.lower())
+set_gauge("beacon_processor_queue_depth", 0)
+set_gauge("beacon_processor_workers_busy", 0)
+set_gauge("beacon_processor_workers_total", 0)
+_BUSY_SECONDS = REGISTRY.counter(
+    "beacon_processor_busy_seconds_total",
+    "cumulative worker-busy wall time; ratio = rate(busy_seconds) / workers",
+)
+_BUSY_SECONDS.inc(0)
+
+
+def _run_in_ctx(ctx, handler, arg):
+    """Run a handler inside the submitter's copied contextvars Context so
+    tracing parentage survives the manager→worker thread hop. Each
+    WorkEvent carries its own copy, so a Context is never entered twice;
+    hand-built events (ctx=None) run in the worker's own context."""
+    if ctx is None:
+        return handler(arg)
+    return ctx.run(handler, arg)
+
 
 @dataclass
 class WorkEvent:
@@ -69,6 +121,12 @@ class WorkEvent:
     # handler(item) for singletons; batch handler receives list[item] when
     # the kind is batched.
     handler: object = None
+    #: stamped by submit(): monotonic enqueue time (0.0 = hand-built event
+    #: that never rode the queue — the wait histogram skips it)
+    submitted_at: float = 0.0
+    #: the submitter's copied contextvars Context: workers run the handler
+    #: inside it so tracing parentage survives the thread hop
+    ctx: object = None
 
 
 @dataclass
@@ -109,6 +167,8 @@ class BeaconProcessor:
         self._work = queue.Queue()  # manager → workers
         self._shutdown = False
         self._idle_workers = num_workers
+        self._busy = 0
+        set_gauge("beacon_processor_workers_total", num_workers)
         self._workers = [
             threading.Thread(target=self._worker_loop, daemon=True, name=f"{name}-w{i}")
             for i in range(num_workers)
@@ -126,13 +186,29 @@ class BeaconProcessor:
 
     def submit(self, work_type: WorkType, item, handler) -> bool:
         """Enqueue work; False (and a drop metric) when the queue is full —
-        the reference's backpressure behavior."""
+        the reference's backpressure behavior. Each event is stamped with
+        its enqueue time (→ the per-kind time-in-queue histogram) and the
+        submitter's copied contextvars Context, so worker-side tracing
+        spans attach under whatever span submitted the work."""
         ev = WorkEvent(work_type, item, handler)
         with self._cv:
             ok = self._queues.push(ev)
             if ok:
+                # stamped only AFTER a successful push — a dropped event
+                # under backpressure must not pay the context copy — but
+                # still under the cv (the manager pops under it too), so
+                # a popped event is always fully stamped
+                ev.submitted_at = time.monotonic()
+                ev.ctx = contextvars.copy_context()
+                kind_depth = len(self._queues.by_type[work_type])
                 self._cv.notify()
-        if not ok:
+        if ok:
+            set_gauge(
+                "beacon_processor_queue_depth_by_kind",
+                kind_depth,
+                kind=work_type.name.lower(),
+            )
+        else:
             inc_counter(
                 "beacon_processor_dropped_total", kind=work_type.name.lower()
             )
@@ -148,13 +224,24 @@ class BeaconProcessor:
                 if self._shutdown and not len(self._queues):
                     break
                 t, batch = self._queues.pop_next()
-                set_gauge("beacon_processor_queue_depth", len(self._queues))
+                # only the drained kind's depth changed on this pop (the
+                # submitter updates the pushed kind's); read both depths
+                # under the cv, publish after it drops so gauge locks stay
+                # off the submit path
+                kind_depth = len(self._queues.by_type[t])
+                total_depth = len(self._queues)
                 if batch:
                     # inflight marked BEFORE the queue lock drops so drain()
                     # can never observe empty-queues + zero-inflight while a
                     # popped batch is still in the manager's hands
                     with self._done_cv:
                         self._inflight += 1
+            set_gauge(
+                "beacon_processor_queue_depth_by_kind",
+                kind_depth,
+                kind=t.name.lower(),
+            )
+            set_gauge("beacon_processor_queue_depth", total_depth)
             if not batch:
                 continue
             self._work.put((t, batch))
@@ -165,6 +252,14 @@ class BeaconProcessor:
             if got is None:
                 return
             t, batch = got
+            pickup = time.monotonic()
+            wait_hist = _QUEUE_WAIT[t]
+            for ev in batch:
+                if ev.submitted_at > 0.0:
+                    wait_hist.observe(pickup - ev.submitted_at)
+            with self._done_cv:
+                self._busy += 1
+                set_gauge("beacon_processor_workers_busy", self._busy)
             try:
                 if t in _BATCHED:
                     # events may carry different batch handlers (gossip vs
@@ -173,13 +268,13 @@ class BeaconProcessor:
                     for ev in batch:
                         key = id(ev.handler)
                         if key not in by_handler:
-                            by_handler[key] = (ev.handler, [])
+                            by_handler[key] = (ev.handler, [], ev.ctx)
                         by_handler[key][1].append(ev.item)
-                    for handler, items in by_handler.values():
-                        handler(items)
+                    for handler, items, ctx in by_handler.values():
+                        _run_in_ctx(ctx, handler, items)
                 else:
                     for ev in batch:
-                        ev.handler(ev.item)
+                        _run_in_ctx(ev.ctx, ev.handler, ev.item)
                 inc_counter(
                     "beacon_processor_processed_total",
                     amount=len(batch),
@@ -190,7 +285,12 @@ class BeaconProcessor:
                     "beacon_processor_errors_total", kind=t.name.lower()
                 )
             finally:
+                busy_s = time.monotonic() - pickup
+                _HANDLER_RUN[t].observe(busy_s)
+                _BUSY_SECONDS.inc(busy_s)
                 with self._done_cv:
+                    self._busy -= 1
+                    set_gauge("beacon_processor_workers_busy", self._busy)
                     self._inflight -= 1
                     self._done_cv.notify_all()
 

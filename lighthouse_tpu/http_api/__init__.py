@@ -20,6 +20,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..metrics import REGISTRY, inc_counter
+from ..metrics.server import serve_trace_path
+from ..utils.tracing import span
 from ..state_processing.accessors import (
     compute_epoch_at_slot,
     compute_start_slot_at_epoch,
@@ -1047,22 +1049,40 @@ class _Handler(BaseHTTPRequestHandler):
         inc_counter("http_api_requests_total", method="GET")
         parsed = urlparse(self.path)
         path = parsed.path
-        try:
-            if path == "/eth/v1/node/health":
-                self.send_response(200)
-                self.end_headers()
-                return
-            if path == "/metrics":
-                body = REGISTRY.expose().encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return
-            if path == "/eth/v1/events":
+        if path == "/eth/v1/node/health":
+            self.send_response(200)
+            self.end_headers()
+            return
+        if path == "/metrics":
+            body = REGISTRY.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        traced = serve_trace_path(path)
+        if traced is not None:
+            # trace READS stay outside the api_request span — fetching a
+            # trace must not push new "api_request" trees into the ring
+            code, obj = traced
+            self._send_json(obj, code)
+            return
+        if path == "/eth/v1/events":
+            # SSE stream: excluded from tracing — the span would stay
+            # open (and the trace undelivered) for the stream's lifetime
+            try:
                 self._serve_events(parse_qs(parsed.query))
-                return
+            except Exception as e:  # noqa: BLE001
+                self._send_json({"code": 500, "message": str(e)}, 500)
+            return
+        # root span of the API serving tier: each request thread gets a
+        # fresh contextvars context, so this is always a trace root
+        with span("api_request", method="GET", path=path):
+            self._dispatch_get(parsed, path)
+
+    def _dispatch_get(self, parsed, path):
+        try:
             m = re.match(r"^/eth/v2/beacon/blocks/(?P<block_id>[^/]+)$", path)
             if m:
                 if "application/octet-stream" in self.headers.get("Accept", ""):
@@ -1200,6 +1220,10 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         path = urlparse(self.path).path
+        with span("api_request", method="POST", path=path):
+            self._dispatch_post(path, body)
+
+    def _dispatch_post(self, path, body):
         try:
             if path == "/eth/v1/beacon/blocks":
                 if "application/octet-stream" in self.headers.get(

@@ -5,6 +5,8 @@ registry of counters/gauges/histograms with `start_timer` helpers, consumed
 by the http_metrics server's text exposition. Collectors are created lazily
 on first use (the reference's lazy_static pattern) so any subsystem can
 record without setup ordering."""
+# lint: allow-file(metric-hygiene) -- the registry helpers themselves take
+# the metric name as a parameter; call SITES are where hygiene is enforced
 
 from __future__ import annotations
 
@@ -32,7 +34,8 @@ class Counter:
             self._values[key] += amount
 
     def value(self, **labels) -> float:
-        return self._values.get(tuple(sorted(labels.items())), 0.0)
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
 
     def values(self) -> dict:
         """Snapshot of every labelled series: {(sorted label items): value}.
@@ -42,8 +45,13 @@ class Counter:
             return dict(self._values)
 
     def expose(self) -> list[str]:
+        # snapshot under the lock: a concurrent inc() introducing a new
+        # label set mid-scrape would otherwise raise "dictionary changed
+        # size during iteration" (Histogram.expose already snapshots)
+        with self._lock:
+            items = sorted(self._values.items())
         out = [f"# TYPE {self.name} counter"]
-        for key, v in sorted(self._values.items()):
+        for key, v in items:
             out.append(f"{self.name}{_fmt_labels(key)} {_fmt_num(v)}")
         return out
 
@@ -65,8 +73,10 @@ class Gauge:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
     def expose(self) -> list[str]:
+        with self._lock:  # see Counter.expose: snapshot vs concurrent set()
+            items = sorted(self._values.items())
         out = [f"# TYPE {self.name} gauge"]
-        for key, v in sorted(self._values.items()):
+        for key, v in items:
             out.append(f"{self.name}{_fmt_labels(key)} {_fmt_num(v)}")
         return out
 
@@ -103,6 +113,13 @@ class Histogram:
     @property
     def sum(self) -> float:
         return self._sum
+
+    def snapshot(self) -> tuple[tuple, list[int], int, float]:
+        """(buckets, per-bucket counts, total, sum) under the lock — the
+        raw material for approximate percentiles (bench queue-wait
+        breakdowns) and delta-based reporting."""
+        with self._lock:
+            return self.buckets, list(self._counts), self._total, self._sum
 
     def expose(self) -> list[str]:
         with self._lock:  # consistent snapshot vs concurrent observe()
@@ -178,9 +195,11 @@ class Registry:
 
     def expose(self) -> str:
         """Prometheus text exposition (http_metrics /metrics body)."""
+        with self._lock:  # snapshot vs a concurrent first-use registration
+            collectors = [self._collectors[n] for n in sorted(self._collectors)]
         lines = []
-        for name in sorted(self._collectors):
-            lines.extend(self._collectors[name].expose())
+        for c in collectors:
+            lines.extend(c.expose())
         return "\n".join(lines) + "\n"
 
 

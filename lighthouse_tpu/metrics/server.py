@@ -1,18 +1,36 @@
 """Standalone Prometheus metrics server.
 
 The beacon_node/http_metrics analog (272 LoC crate): a tiny HTTP server
-exposing the process-global registry's text exposition at /metrics and a
-liveness probe at /health, independent of the Beacon API server so
-operators can firewall the two separately (the reference binds them on
-different ports for the same reason)."""
+exposing the process-global registry's text exposition at /metrics, a
+liveness probe at /health, and the trace-collector's trace trees at
+/lighthouse/traces (+ /lighthouse/traces/<id> as Chrome trace-event
+JSON), independent of the Beacon API server so operators can firewall
+the two separately (the reference binds them on different ports for the
+same reason)."""
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import REGISTRY
-from .system_health import observe_system_health
+from .trace_collector import COLLECTOR
+
+
+def serve_trace_path(path: str):
+    """Shared /lighthouse/traces router (MetricsServer + Beacon API):
+    returns (status, json-able body) or None when the path is not a
+    trace endpoint."""
+    if path == "/lighthouse/traces":
+        return 200, COLLECTOR.index_json()
+    if path.startswith("/lighthouse/traces/"):
+        trace_id = path.rsplit("/", 1)[1]
+        chrome = COLLECTOR.chrome_json(trace_id)
+        if chrome is None:
+            return 404, {"message": f"trace {trace_id} not held (ring/reservoir evicted?)"}
+        return 200, chrome
+    return None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -22,23 +40,30 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def do_GET(self):
-        if self.path.split("?")[0] == "/metrics":
+        from .system_health import observe_system_health
+
+        path = self.path.split("?")[0]
+        content_type = "text/plain"
+        traced = serve_trace_path(path)
+        if traced is not None:
+            code, obj = traced
+            body = json.dumps(obj).encode()
+            content_type = "application/json"
+            self.send_response(code)
+        elif path == "/metrics":
             # refresh host gauges at scrape time, as the reference's
             # gather() does per scrape — into the registry being served
             observe_system_health(self.registry)
             body = self.registry.expose().encode()
+            content_type = "text/plain; version=0.0.4; charset=utf-8"
             self.send_response(200)
-            self.send_header(
-                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
-            )
-        elif self.path.split("?")[0] == "/health":
+        elif path == "/health":
             body = b"OK"
             self.send_response(200)
-            self.send_header("Content-Type", "text/plain")
         else:
             body = b"not found"
             self.send_response(404)
-            self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

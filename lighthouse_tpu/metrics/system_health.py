@@ -100,6 +100,7 @@ def observe_system_health(registry=None):
     if registry is None:
         setter = set_gauge
     else:
+        # lint: allow(metric-hygiene) -- forwarding shim; every call below passes a literal
         setter = lambda name, v: registry.gauge(name).set(v)  # noqa: E731
     setter("system_total_memory_bytes", h.total_memory_bytes)
     setter("system_free_memory_bytes", h.free_memory_bytes)

@@ -679,9 +679,21 @@ class NetworkService:
             raise RpcError(str(e)) from e
 
     def _on_gossip_block(self, data: bytes):
+        import time as _time
+
         signed = self.decode_block(data)
         from ..beacon_chain.chain import BlobsUnavailableError, BlockError
 
+        # observation milestone at the earliest point we can name the
+        # block: even if the import below detours through a parent lookup,
+        # the eventual BlockTimes keeps the true gossip arrival time.
+        # Clock-clamped: a hostile far-future slot must not enter the
+        # cache (it would never be min-slot-evicted nor finality-pruned)
+        slot = int(signed.message.slot)
+        if slot <= self.chain.slot_clock.now() + 1:
+            self.chain.block_times_cache.set_observed(
+                signed.message.hash_tree_root(), slot, _time.monotonic()
+            )
         try:
             root = self.chain.process_block(signed)
         except BlobsUnavailableError:

@@ -84,9 +84,10 @@ class BlockLookups:
         finally:
             with self._lock:
                 self._inflight.discard(root)
-        inc_counter(
-            "sync_lookups_completed_total" if ok else "sync_lookups_failed_total"
-        )
+        if ok:
+            inc_counter("sync_lookups_completed_total")
+        else:
+            inc_counter("sync_lookups_failed_total")
 
     def _run(self, target_root: bytes, block) -> bool:
         chain = self.service.chain

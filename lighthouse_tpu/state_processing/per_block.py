@@ -241,6 +241,7 @@ def per_block_processing(
     verify_block_root: bool = True,
     proposal_already_verified: bool = False,
     execution_engine=None,
+    milestones=None,
 ):
     """Apply `signed_block` to `state` in place. Raises BlockProcessingError
     on ANY invalid condition (per_block_processing.rs:100) — malformed
@@ -248,11 +249,15 @@ def per_block_processing(
     IndexError/ValueError (the reference's fallible set constructors return
     ValidatorUnknown etc.). `proposal_already_verified` skips the proposer
     signature (the SignatureVerifiedBlock::from_gossip_verified_block path,
-    block_verification.rs:1084)."""
+    block_verification.rs:1084). `milestones` is an optional callback
+    (`milestones("signature_verified")`, `milestones("payload_verified")`)
+    the chain uses to stamp its BlockTimesCache at the exact pipeline
+    points — the latency-attribution seam, not a behavior hook."""
     try:
         _per_block_processing_inner(
             state, signed_block, spec, E, strategy, ctxt, block_root,
             verify_block_root, proposal_already_verified, execution_engine,
+            milestones,
         )
     except BlockProcessingError:
         raise
@@ -263,6 +268,7 @@ def per_block_processing(
 def _per_block_processing_inner(
     state, signed_block, spec, E, strategy, ctxt, block_root,
     verify_block_root, proposal_already_verified, execution_engine=None,
+    milestones=None,
 ):
     block = signed_block.message
     if ctxt is None:
@@ -302,6 +308,10 @@ def _per_block_processing_inner(
             raise BlockProcessingError("invalid proposer signature")
     elif strategy == BlockSignatureStrategy.VERIFY_RANDAO:
         pass  # randao handled in process_randao below
+    if milestones is not None:
+        # signatures settled (verified here, or pre-verified upstream for
+        # the NO_VERIFICATION segment path)
+        milestones("signature_verified")
 
     from ..types.chain_spec import ForkName
     from ..types.containers import build_types
@@ -324,6 +334,10 @@ def _per_block_processing_inner(
             process_execution_payload(
                 state, block.body, spec, E, fork, engine=execution_engine
             )
+    if milestones is not None:
+        # pre-merge / payload-free blocks verify trivially — the milestone
+        # still lands so the slot-anchored chain is complete on every fork
+        milestones("payload_verified")
     process_randao(
         state,
         block,

@@ -51,8 +51,14 @@ def process_epoch(state, spec: ChainSpec, E):
     from ..types.chain_spec import ForkName
     from ..types.containers import build_types
 
+    from ..utils.tracing import span
+
     fork = build_types(E).fork_of_state(state)
-    with start_timer("epoch_transition_seconds"):
+    # `epoch_transition` is a root-span name in the trace taxonomy
+    # (OBSERVABILITY.md): standalone transitions land in the collector as
+    # their own trees; boundary transitions inside a block import nest
+    # under that trace's state_transition span
+    with start_timer("epoch_transition_seconds"), span("epoch_transition"):
         if fork >= ForkName.ALTAIR:
             from .altair import process_epoch_altair
 

@@ -17,6 +17,13 @@ class SlotClock:
     def seconds_into_slot(self) -> float:
         raise NotImplementedError
 
+    def slot_offset_seconds(self, slot: int) -> float:
+        """Seconds elapsed since the START of `slot`, on this clock's own
+        timeline — the slot-anchored delay the block/attestation latency
+        histograms observe (the reference's `seconds_from_current_slot_start`
+        family). Negative for future slots."""
+        raise NotImplementedError
+
 
 class SystemTimeSlotClock(SlotClock):
     def __init__(self, genesis_time: int, seconds_per_slot: int):
@@ -35,14 +42,20 @@ class SystemTimeSlotClock(SlotClock):
     def seconds_into_slot(self) -> float:
         return (time.time() - self.genesis_time) % self.seconds_per_slot
 
+    def slot_offset_seconds(self, slot: int) -> float:
+        return time.time() - self.slot_start_seconds(slot)
+
 
 class ManualSlotClock(SlotClock):
-    """Test clock advanced by hand (manual_slot_clock.rs)."""
+    """Test clock advanced by hand (manual_slot_clock.rs). Sub-slot time
+    is manual too (`set_seconds_into_slot`) so tests can place an event
+    at an exact slot-relative instant — e.g. a deliberately late head."""
 
     def __init__(self, genesis_time: int = 0, seconds_per_slot: int = 12):
         self.genesis_time = genesis_time
         self.seconds_per_slot = seconds_per_slot
         self._slot = 0
+        self._seconds_into_slot = 0.0
 
     def now(self) -> int:
         return self._slot
@@ -56,5 +69,14 @@ class ManualSlotClock(SlotClock):
     def slot_start_seconds(self, slot: int) -> int:
         return self.genesis_time + slot * self.seconds_per_slot
 
+    def set_seconds_into_slot(self, seconds: float):
+        self._seconds_into_slot = float(seconds)
+
     def seconds_into_slot(self) -> float:
-        return 0.0
+        return self._seconds_into_slot
+
+    def slot_offset_seconds(self, slot: int) -> float:
+        return (
+            (self._slot - slot) * self.seconds_per_slot
+            + self._seconds_into_slot
+        )

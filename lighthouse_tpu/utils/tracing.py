@@ -1,4 +1,4 @@
-"""Lightweight tracing spans.
+"""Lightweight tracing spans, assembled into trace trees.
 
 The reference threads `tracing` spans through the node (common/logging
 bridges slog+tracing; spans carry timing and parentage). This module is
@@ -7,10 +7,21 @@ the same capability sized to this runtime: context-manager spans that
   * record wall time into the metrics registry (one histogram per span
     name: `trace_span_seconds_<name>` — Prometheus-visible),
   * know their parent (contextvars, so they follow the work across
-    threads started with `copy_context` and stay correct under asyncio),
+    threads started with `copy_context` — the beacon_processor runs each
+    handler inside the submitter's copied context, so worker-side spans
+    attach under the submitting span — and stay correct under asyncio),
+  * assemble into TREES: every span carries its root's `trace_id`,
+    children attach to their parent on close, and a completed ROOT span
+    (no parent) is delivered to `metrics.trace_collector.COLLECTOR`
+    (recent-ring + slowest-K reservoir, Chrome trace-event export at
+    `/lighthouse/traces`),
   * and emit one structured log line per span at close
     (`span=<name> parent=<name> ms=<dur>`), rate-limited per span name
     so hot paths don't flood the log.
+
+`LIGHTHOUSE_TPU_TRACE_COLLECT=0` disables tree assembly and collection
+entirely (checked at root-span entry; children inherit the decision):
+spans revert to exactly the flat per-name histogram + log behavior.
 
 Usage:
     with span("block_import", root="0x.."):
@@ -23,9 +34,13 @@ from __future__ import annotations
 
 import contextvars
 import functools
+import itertools
+import os
+import threading
 import time
 
 from ..metrics import REGISTRY
+from ..metrics.trace_collector import COLLECTOR
 from .logging import get_logger
 
 log = get_logger("lighthouse_tpu.trace")
@@ -38,37 +53,79 @@ _current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
 _LOG_EVERY = 5.0
 _last_logged: dict[str, float] = {}
 
+_trace_ids = itertools.count(1)
+
+
+def _collect_enabled() -> bool:
+    return os.environ.get("LIGHTHOUSE_TPU_TRACE_COLLECT", "1") != "0"
+
 
 class Span:
-    __slots__ = ("name", "fields", "parent", "_t0", "_token", "duration_s")
+    __slots__ = (
+        "name",
+        "fields",
+        "parent",
+        "children",
+        "trace_id",
+        "tid",
+        "t0",
+        "_token",
+        "_collect",
+        "duration_s",
+    )
 
     def __init__(self, name: str, **fields):
         self.name = name
         self.fields = fields
         self.parent: Span | None = None
+        self.children: list[Span] = []
+        self.trace_id: str | None = None
+        self.tid = 0
         self.duration_s: float | None = None
-        self._t0 = 0.0
+        self.t0 = 0.0
         self._token = None
+        self._collect = False
 
     def __enter__(self) -> "Span":
         self.parent = _current.get()
+        if self.parent is not None:
+            # inherit the root's collect decision and identity — one env
+            # read per TRACE, not per span
+            self._collect = self.parent._collect
+            self.trace_id = self.parent.trace_id
+        else:
+            self._collect = _collect_enabled()
+            if self._collect:
+                self.trace_id = f"{next(_trace_ids):012x}"
+        self.tid = threading.get_ident() & 0xFFFF
         self._token = _current.set(self)
-        self._t0 = time.perf_counter()
+        self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        self.duration_s = time.perf_counter() - self._t0
+        self.duration_s = time.perf_counter() - self.t0
         _current.reset(self._token)
         REGISTRY.histogram(
+            # hygiene is enforced at span() call sites, not here:
+            # lint: allow(metric-hygiene) -- the span machinery itself
             f"trace_span_seconds_{self.name}",
             f"span duration: {self.name}",
         ).observe(self.duration_s)
+        if self._collect:
+            if self.parent is not None:
+                # attach on close: the parent object survives even if it
+                # already closed (cross-thread children may finish late —
+                # the collector stores the live tree and walks snapshots)
+                self.parent.children.append(self)
+            else:
+                COLLECTOR.record(self)
         now = time.monotonic()
         if now - _last_logged.get(self.name, 0.0) >= _LOG_EVERY:
             _last_logged[self.name] = now
             record = {
                 "span": self.name,
                 "parent": self.parent.name if self.parent else None,
+                "trace": self.trace_id,
                 "ms": round(self.duration_s * 1000, 2),
                 "error": exc_type.__name__ if exc_type else None,
             }

@@ -30,8 +30,16 @@ def pytest_sessionstart(session):
     counters through the metrics registry (the bench JSON and /metrics
     consumers rely on the series existing even at zero)."""
     from lighthouse_tpu.analysis import sanitizer  # noqa: F401 — registers
+    from lighthouse_tpu.beacon_chain import (  # noqa: F401 — registers
+        attestation_verification,  # gossip observation-delay histograms
+        block_times_cache,  # slot-anchored block-delay histograms
+    )
+    from lighthouse_tpu.beacon_processor import (  # noqa: F401 — registers
+        WorkType,  # queue-wait/work histograms + depth/busy gauges
+    )
     from lighthouse_tpu.crypto import bls  # noqa: F401 — registers counters
     from lighthouse_tpu.metrics import REGISTRY
+    from lighthouse_tpu.metrics import trace_collector  # noqa: F401 — registers
     from lighthouse_tpu.network import sync  # noqa: F401 — registers sync series
     from lighthouse_tpu.state_processing import (  # noqa: F401 — registers
         attestation_batch,  # the batch path counter + attestation_apply span
@@ -97,6 +105,39 @@ def pytest_sessionstart(session):
         'sanitizer_violations_total{rule="cow-write"}',
         'sanitizer_violations_total{rule="u64-wrap"}',
         'sanitizer_violations_total{rule="stale-read"}',
+        # PR 9: observability pipeline series — trace collector, queue
+        # observability, slot-anchored block/attestation delays — must
+        # exist at zero (the traces endpoints, sync_catchup queue-wait
+        # breakdown and dashboards read them eagerly)
+        'trace_collector_traces_total{root="block_import"}',
+        'trace_collector_traces_total{root="epoch_transition"}',
+        'trace_collector_traces_total{root="attestation_batch"}',
+        'trace_collector_traces_total{root="sync_range_batch"}',
+        'trace_collector_traces_total{root="api_request"}',
+        'trace_collector_traces_total{root="other"}',
+        "trace_collector_ring_size",
+        *(
+            f"beacon_processor_queue_wait_seconds_{t.name.lower()}"
+            for t in WorkType
+        ),
+        *(
+            f"beacon_processor_work_seconds_{t.name.lower()}"
+            for t in WorkType
+        ),
+        'beacon_processor_queue_depth_by_kind{kind="chain_segment"}',
+        'beacon_processor_queue_depth_by_kind{kind="gossip_attestation"}',
+        "beacon_processor_queue_depth",
+        "beacon_processor_workers_busy",
+        "beacon_processor_workers_total",
+        "beacon_processor_busy_seconds_total",
+        "beacon_block_observed_slot_start_delay_seconds",
+        "beacon_block_gossip_verified_slot_start_delay_seconds",
+        "beacon_block_signature_verified_slot_start_delay_seconds",
+        "beacon_block_payload_verified_slot_start_delay_seconds",
+        "beacon_block_imported_slot_start_delay_seconds",
+        "beacon_block_head_slot_start_delay_seconds",
+        "beacon_attestation_gossip_slot_start_delay_seconds",
+        "beacon_aggregate_gossip_slot_start_delay_seconds",
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"

@@ -298,6 +298,62 @@ def test_dirty_channel_clean_when_reads_precede_handle():
 
 
 # ---------------------------------------------------------------------------
+# metric-hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_metric_hygiene_fires_on_fstring_name():
+    bad = (
+        "from lighthouse_tpu.metrics import inc_counter\n"
+        "def f(kind):\n"
+        "    inc_counter(f'work_done_{kind}_total')\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["metric-hygiene"]
+
+
+def test_metric_hygiene_fires_on_dynamic_registry_and_span_names():
+    bad = (
+        "from lighthouse_tpu.metrics import REGISTRY\n"
+        "from lighthouse_tpu.utils.tracing import span\n"
+        "def f(name, peer):\n"
+        "    REGISTRY.histogram(name).observe(1.0)\n"
+        "    with span('rpc_' + peer):\n"
+        "        pass\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["metric-hygiene"] * 2
+
+
+def test_metric_hygiene_clean_for_literals_and_module_constants():
+    good = (
+        "from lighthouse_tpu.metrics import REGISTRY, inc_counter, observe\n"
+        "from lighthouse_tpu.utils.tracing import span\n"
+        "IMPORT_SPAN = 'block_import'\n"
+        "def f(hist, cache, epoch, index):\n"
+        "    inc_counter('beacon_blocks_imported_total')\n"
+        "    observe('beacon_block_observed_to_imported_seconds', 0.1)\n"
+        "    REGISTRY.histogram('trace_span_seconds_block_import')\n"
+        "    with span(IMPORT_SPAN):\n"
+        "        pass\n"
+        "    hist.observe(1.0)\n"  # method named observe: not a metric call
+        "    cache.observe(epoch, index)\n"  # ObservedCache.observe likewise
+    )
+    assert lint_source(good, OUT) == []
+
+
+def test_metric_hygiene_suppressible_like_any_rule():
+    src = (
+        "from lighthouse_tpu.metrics import REGISTRY\n"
+        "KINDS = ('a', 'b')\n"
+        "for k in KINDS:\n"
+        "    REGISTRY.counter(\n"
+        "        # lint: allow(metric-hygiene) -- bounded by KINDS\n"
+        "        f'work_{k}_total',\n"
+        "    )\n"
+    )
+    assert lint_source(src, OUT) == []
+
+
+# ---------------------------------------------------------------------------
 # Suppressions
 # ---------------------------------------------------------------------------
 
