@@ -43,7 +43,11 @@ SMOKE = os.environ.get("BENCH_SMOKE") == "1"
 
 # Persistent compile cache: pairing-class kernels take minutes to compile;
 # cache across runs (and across warm-up runs before the driver's bench).
-from lighthouse_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+from lighthouse_tpu.utils.compile_cache import (  # noqa: E402
+    compile_cache_stats,
+    enable_compile_cache,
+    track_device_compile,
+)
 
 enable_compile_cache()
 
@@ -117,7 +121,8 @@ def bench_merkle(jax):
         jax.block_until_ready(root_words[0])
         return root_words
 
-    run()  # compile
+    with track_device_compile("merkle_tree_levels"):
+        run()  # compile
     t = _trials(run, n=5)
 
     # host control on a 1/16 slice, extrapolated
@@ -305,7 +310,11 @@ def _bench_bls_device(jax):
             assert verify_signature_sets_device_full(sets, random.Random(5))
 
     t0 = time.perf_counter()
-    dev_run(phase="compile")  # compile + cache warm
+    # compile-vs-execute through the standard metrics path: the warmup
+    # rides a device_compile span and the compile_cache_{hits,misses}/
+    # compile-seconds counters (reported below), not just phase labels
+    with track_device_compile("bls381_verify"):
+        dev_run(phase="compile")  # compile + cache warm
     compile_s = time.perf_counter() - t0
     _partial(phase="compile", s=round(compile_s, 2))
     t = _trials(dev_run, n=3)
@@ -335,6 +344,7 @@ def _bench_bls_device(jax):
             "s": round(compile_s, 2),
             "over_execute_s": round(compile_s - t["median_s"], 2),
         },
+        "compile_cache": compile_cache_stats(),
         "spread": t,
     }
 
@@ -432,7 +442,8 @@ def bench_kzg(jax):
     def dev_run():
         assert dev.verify_blob_kzg_proof_batch(blobs, cs, proofs)
 
-    dev_run()  # compile + cache warm
+    with track_device_compile("kzg_verify_blob_batch"):
+        dev_run()  # compile + cache warm
     assert dev._dev is not None, "device KZG fell back to host mid-bench"
     t = _trials(dev_run, n=3)
 
@@ -449,6 +460,7 @@ def bench_kzg(jax):
         "vs_baseline": round(th["median_s"] / t["median_s"], 3),
         "baseline_control": "host bigint engine, same machine",
         "config": {"blobs": n_blobs, "domain": n_domain},
+        "compile_cache": compile_cache_stats(),
         "spread": t,
         "control_spread": th,
     }
@@ -1195,10 +1207,28 @@ def _collect_partials(stdout) -> list:
 
 
 def _run_one(name: str) -> int:
-    """Subprocess entry: run ONE metric, print its JSON."""
+    """Subprocess entry: run ONE metric, print its JSON. Under --profile
+    (BENCH_PROFILE=1, inherited from the parent) the stack sampler runs
+    across the metric's trials and the top hotspot stacks per trace root
+    ride along under `hotspots`; the result is flagged `profiled` so
+    --compare refuses to score it against an unprofiled baseline."""
     import jax
 
-    print(json.dumps(_METRICS[name](jax)))
+    if os.environ.get("BENCH_PROFILE") != "1":
+        print(json.dumps(_METRICS[name](jax)))
+        return 0
+    from lighthouse_tpu.metrics.profiler import StackProfiler
+
+    prof = StackProfiler()
+    prof.start()
+    try:
+        result = _METRICS[name](jax)
+    finally:
+        prof.stop()
+    result["hotspots"] = prof.top_stacks(n=5)
+    result["profile"] = {"hz": prof.hz, "samples": prof.samples_total}
+    result["profiled"] = True
+    print(json.dumps(result))
     return 0
 
 
@@ -1267,6 +1297,11 @@ def main():
         out["details"] = [d for d in details if d is not head]
         if errors:
             out["errors"] = dict(errors)
+        if os.environ.get("BENCH_PROFILE") == "1":
+            # profiled trials carry sampling overhead (bounded ≤1.10× by
+            # perf_smoke, but real): flag the whole line so --compare and
+            # baseline rebasing skip it, like the sanitize-mode exclusion
+            out["profiled"] = True
         print(json.dumps(out), flush=True)
 
     secondary_caps = {
@@ -1303,6 +1338,117 @@ def main():
     emit(head if head is not None else details[0])
 
 
+def _load_bench_entries(path: str) -> tuple[dict, bool]:
+    """Flatten one bench JSON (a combined line, or a driver BENCH_rXX.json
+    wrapper whose `parsed` holds it) into {metric: entry}; second element
+    reports whether the run was profiled (not comparable)."""
+    with open(path) as f:
+        raw = json.load(f)
+    if "parsed" in raw and isinstance(raw["parsed"], dict):
+        raw = raw["parsed"]
+    entries: dict[str, dict] = {}
+
+    def add(e):
+        if (
+            isinstance(e, dict)
+            and isinstance(e.get("metric"), str)
+            and isinstance(e.get("value"), (int, float))
+        ):
+            entries[e["metric"]] = e
+
+    add(raw)
+    for d in raw.get("details", ()):
+        add(d)
+    profiled = bool(raw.get("profiled")) or any(
+        e.get("profiled") for e in entries.values()
+    )
+    return entries, profiled
+
+
+def _rel_spread(entry: dict) -> float:
+    """(max-min)/median of a metric's trial spread — its noise floor.
+    Metrics without a recorded spread (e.g. block_import_ms) report 0
+    and fall back to the bare threshold."""
+    s = entry.get("spread")
+    if not isinstance(s, dict):
+        return 0.0
+    try:
+        med = float(s["median_s"])
+        return (float(s["max_s"]) - float(s["min_s"])) / med if med else 0.0
+    except (KeyError, TypeError, ValueError, ZeroDivisionError):
+        return 0.0
+
+
+def _higher_is_better(unit: str) -> bool:
+    return "/sec" in (unit or "")
+
+
+def compare_runs(old_path: str, new_path: str, threshold: float = 0.15) -> int:
+    """`bench.py --compare OLD.json NEW.json`: the regression sentinel.
+    For every metric present in both files, compute the regression
+    fraction in the metric's own direction (throughputs regress down,
+    latencies regress up) and flag it when it exceeds
+    max(threshold, (old_spread + new_spread) / 2) — spread-aware, so a
+    metric whose own trials wobble 20% needs a >20% move to fire.
+    Prints a per-metric delta table; exits 1 on any REGRESSED metric,
+    2 when either side is a profiled (non-comparable) run."""
+    old, old_prof = _load_bench_entries(old_path)
+    new, new_prof = _load_bench_entries(new_path)
+    if old_prof or new_prof:
+        which = " and ".join(
+            p for p, flag in ((old_path, old_prof), (new_path, new_prof)) if flag
+        )
+        print(
+            f"refusing to compare: {which} recorded under --profile "
+            "(sampler overhead rides the numbers; re-run without it)"
+        )
+        return 2
+    shared = [m for m in old if m in new]
+    if not shared:
+        print(f"no shared metrics between {old_path} and {new_path}")
+        return 2
+    rows = []
+    regressed = []
+    for m in sorted(shared):
+        o, n = old[m], new[m]
+        ov, nv = float(o["value"]), float(n["value"])
+        if ov == 0:
+            rows.append((m, ov, nv, "n/a", "n/a", "SKIP (old=0)"))
+            continue
+        higher = _higher_is_better(n.get("unit") or o.get("unit") or "")
+        # regression fraction, positive = worse in this metric's direction
+        r = (ov - nv) / ov if higher else (nv - ov) / ov
+        tol = max(threshold, (_rel_spread(o) + _rel_spread(n)) / 2.0)
+        if r > tol:
+            verdict = "REGRESSED"
+            regressed.append(m)
+        elif -r > tol:
+            verdict = "improved"
+        else:
+            verdict = "ok"
+        delta_pct = (nv - ov) / ov * 100.0
+        rows.append(
+            (m, ov, nv, f"{delta_pct:+.1f}%", f"±{tol * 100:.0f}%", verdict)
+        )
+    widths = [max(len(str(r[i])) for r in rows + [("metric", "old", "new",
+               "delta", "tolerance", "verdict")]) for i in range(6)]
+    header = ("metric", "old", "new", "delta", "tolerance", "verdict")
+    for row in [header] + rows:
+        print("  ".join(str(c).ljust(w) for c, w in zip(row, widths)))
+    only_old = sorted(set(old) - set(new))
+    only_new = sorted(set(new) - set(old))
+    if only_old:
+        print(f"only in {old_path}: {', '.join(only_old)}")
+    if only_new:
+        print(f"only in {new_path}: {', '.join(only_new)}")
+    if regressed:
+        print(f"REGRESSION: {', '.join(regressed)} "
+              f"(median worse by more than the spread-aware threshold)")
+        return 1
+    print(f"ok: {len(shared)} shared metrics within threshold")
+    return 0
+
+
 def _refuse_sanitize_mode():
     """Sanitize mode write-guards buffers and runs wide-dtype checks on
     every sweep — numbers recorded under it are not comparable to the
@@ -1323,7 +1469,8 @@ def _refuse_sanitize_mode():
 
 
 def _parse_args(argv: list[str]) -> list[str]:
-    """Strip --bls-backend (propagated via env to metric subprocesses)."""
+    """Strip --bls-backend / --profile (both propagated via env to the
+    metric subprocesses)."""
     out = []
     i = 0
     while i < len(argv):
@@ -1335,6 +1482,9 @@ def _parse_args(argv: list[str]) -> list[str]:
         elif argv[i].startswith("--bls-backend="):
             os.environ["BENCH_BLS_BACKEND"] = argv[i].split("=", 1)[1]
             i += 1
+        elif argv[i] == "--profile":
+            os.environ["BENCH_PROFILE"] = "1"
+            i += 1
         else:
             out.append(argv[i])
             i += 1
@@ -1343,6 +1493,12 @@ def _parse_args(argv: list[str]) -> list[str]:
 
 if __name__ == "__main__":
     argv = _parse_args(sys.argv[1:])
+    if argv and argv[0] == "--compare":
+        # pure file comparison: no metrics run, sanitize mode irrelevant.
+        # Bad arity must ERROR, not fall through into a full bench run
+        if len(argv) != 3:
+            raise SystemExit("usage: bench.py --compare OLD.json NEW.json")
+        sys.exit(compare_runs(argv[1], argv[2]))
     # covers the --metric subprocess entry too: no timed trial ever runs
     # with the sanitizer's guards armed
     _refuse_sanitize_mode()

@@ -24,6 +24,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from ..metrics import REGISTRY, inc_counter, set_gauge
+from ..utils.tracing import adopt_thread_span, current_span
 
 MAX_GOSSIP_ATTESTATION_BATCH_SIZE = 64
 MAX_GOSSIP_AGGREGATE_BATCH_SIZE = 64
@@ -111,7 +112,17 @@ def _run_in_ctx(ctx, handler, arg):
     hand-built events (ctx=None) run in the worker's own context."""
     if ctx is None:
         return handler(arg)
-    return ctx.run(handler, arg)
+    return ctx.run(_run_adopted, handler, arg)
+
+
+def _run_adopted(handler, arg):
+    """Inside the submitter's context on the worker thread: adopt the
+    submitting span in the profiler's thread→span registry for the whole
+    handler run, so worker stack samples taken between (or outside) the
+    handler's own spans still land under the submitting trace root —
+    block_import / sync_range_batch — instead of "unattributed"."""
+    with adopt_thread_span(current_span()):
+        return handler(arg)
 
 
 @dataclass

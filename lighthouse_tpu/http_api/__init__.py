@@ -20,7 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..metrics import REGISTRY, inc_counter
-from ..metrics.server import serve_trace_path
+from ..metrics.server import serve_lighthouse_path
 from ..utils.tracing import span
 from ..state_processing.accessors import (
     compute_epoch_at_slot,
@@ -1033,9 +1033,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_bytes(self, data: bytes, code=200, version: str | None = None):
+    def _send_bytes(self, data: bytes, code=200, version: str | None = None,
+                    content_type: str = "application/octet-stream"):
         self.send_response(code)
-        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Type", content_type)
         if version is not None:
             # beacon-API consensus-version header: SSZ consumers need the
             # fork to pick the right container family (e.g. Electra's
@@ -1054,19 +1055,19 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             return
         if path == "/metrics":
-            body = REGISTRY.expose().encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._send_bytes(
+                REGISTRY.expose().encode(),
+                content_type="text/plain; version=0.0.4",
+            )
             return
-        traced = serve_trace_path(path)
-        if traced is not None:
-            # trace READS stay outside the api_request span — fetching a
-            # trace must not push new "api_request" trees into the ring
-            code, obj = traced
-            self._send_json(obj, code)
+        served = serve_lighthouse_path(path, parsed.query)
+        if served is not None:
+            # observability READS (traces/profile/health) stay outside the
+            # api_request span — fetching a trace must not push new
+            # "api_request" trees into the ring, and profiling the
+            # profile endpoint would only measure itself
+            code, content_type, body = served
+            self._send_bytes(body, code, content_type=content_type)
             return
         if path == "/eth/v1/events":
             # SSE stream: excluded from tracing — the span would stay
@@ -1315,6 +1316,9 @@ class HttpApiServer:
         self._thread = None
 
     def start(self):
+        from ..metrics.profiler import maybe_start_profiler
+
+        maybe_start_profiler()  # no-op (and no thread) unless armed by env
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True, name="http_api"
         )

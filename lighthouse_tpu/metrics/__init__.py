@@ -14,6 +14,13 @@ import threading
 import time
 from collections import defaultdict
 
+#: process birth anchors for /lighthouse/health uptime — captured HERE
+#: because this module is imported at node assembly, while system_health
+#: is imported lazily on the first scrape (its import time would read as
+#: a near-zero uptime)
+PROCESS_START_MONOTONIC = time.monotonic()
+PROCESS_START_EPOCH = time.time()
+
 _DEFAULT_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
 )

@@ -2,17 +2,21 @@
 
 The beacon_node/http_metrics analog (272 LoC crate): a tiny HTTP server
 exposing the process-global registry's text exposition at /metrics, a
-liveness probe at /health, and the trace-collector's trace trees at
-/lighthouse/traces (+ /lighthouse/traces/<id> as Chrome trace-event
-JSON), independent of the Beacon API server so operators can firewall
-the two separately (the reference binds them on different ports for the
-same reason)."""
+liveness probe at /health, and the lighthouse operator endpoints —
+trace trees at /lighthouse/traces (+ /lighthouse/traces/<id> as Chrome
+trace-event JSON), profiler output at /lighthouse/profile (collapsed
+stacks / speedscope JSON), and process vitals at /lighthouse/health —
+independent of the Beacon API server so operators can firewall the two
+separately (the reference binds them on different ports for the same
+reason). The Beacon API serves the same /lighthouse/* routes through
+`serve_lighthouse_path`."""
 
 from __future__ import annotations
 
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 from . import REGISTRY
 from .trace_collector import COLLECTOR
@@ -33,6 +37,57 @@ def serve_trace_path(path: str):
     return None
 
 
+def serve_lighthouse_path(path: str, query: str = ""):
+    """Shared router for every /lighthouse/* operator endpoint (traces,
+    profile, health), used verbatim by the MetricsServer and the Beacon
+    API. Returns (status, content_type, body_bytes) or None when the
+    path is not a lighthouse endpoint."""
+    traced = serve_trace_path(path)
+    if traced is not None:
+        code, obj = traced
+        return code, "application/json", json.dumps(obj).encode()
+    if path == "/lighthouse/profile":
+        from .profiler import PROFILER
+
+        q = parse_qs(query)
+        root = q.get("root", [None])[0]
+        fmt = q.get("format", ["speedscope"])[0]
+        if not PROFILER.running and PROFILER.samples_total == 0:
+            return (
+                503,
+                "application/json",
+                json.dumps(
+                    {
+                        "message": (
+                            "profiler disabled — set LIGHTHOUSE_TPU_PROFILE=1 "
+                            "(sampler arms at server start) or run "
+                            "bench.py --profile"
+                        )
+                    }
+                ).encode(),
+            )
+        if fmt == "collapsed":
+            return (
+                200,
+                "text/plain; charset=utf-8",
+                PROFILER.collapsed(root).encode(),
+            )
+        return (
+            200,
+            "application/json",
+            json.dumps(PROFILER.speedscope(root)).encode(),
+        )
+    if path == "/lighthouse/health":
+        from .system_health import process_health
+
+        return (
+            200,
+            "application/json",
+            json.dumps({"data": process_health()}).encode(),
+        )
+    return None
+
+
 class _Handler(BaseHTTPRequestHandler):
     registry = REGISTRY
 
@@ -42,13 +97,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         from .system_health import observe_system_health
 
-        path = self.path.split("?")[0]
+        path, _, query = self.path.partition("?")
         content_type = "text/plain"
-        traced = serve_trace_path(path)
-        if traced is not None:
-            code, obj = traced
-            body = json.dumps(obj).encode()
-            content_type = "application/json"
+        served = serve_lighthouse_path(path, query)
+        if served is not None:
+            code, content_type, body = served
             self.send_response(code)
         elif path == "/metrics":
             # refresh host gauges at scrape time, as the reference's
@@ -79,6 +132,9 @@ class MetricsServer:
         self._thread: threading.Thread | None = None
 
     def start(self) -> "MetricsServer":
+        from .profiler import maybe_start_profiler
+
+        maybe_start_profiler()  # no-op (and no thread) unless armed by env
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True, name="http-metrics"
         )

@@ -10,10 +10,11 @@ from __future__ import annotations
 
 import os
 import shutil
+import threading
 import time
 from dataclasses import asdict, dataclass
 
-from . import set_gauge
+from . import PROCESS_START_EPOCH, PROCESS_START_MONOTONIC, set_gauge
 
 
 @dataclass
@@ -91,6 +92,54 @@ def system_health(path: str = "/") -> SystemHealth:
         network_bytes_received=recv,
         observed_at=time.time(),
     )
+
+
+def _proc_self_status_kb(field: str) -> int:
+    """One `VmXXX:` row of /proc/self/status in kB (0 where missing)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith(field + ":"):
+                    return int(line.split()[1])
+    except (OSError, IndexError, ValueError):
+        pass
+    return 0
+
+
+def process_health() -> dict:
+    """The /lighthouse/health body (the reference's /lighthouse/ui/health
+    analog): process vitals plus node state read back out of the
+    process-global registry's gauges — uptime, RSS/peak RSS, GC
+    generation counts, live threads, sync state, worker-busy ratio, and
+    the trace-collector ring size."""
+    import gc
+
+    from . import REGISTRY
+    from .profiler import PROFILER
+
+    workers = REGISTRY.gauge("beacon_processor_workers_total").value()
+    busy = REGISTRY.gauge("beacon_processor_workers_busy").value()
+    return {
+        "uptime_seconds": round(time.monotonic() - PROCESS_START_MONOTONIC, 3),
+        "started_at_unix": int(PROCESS_START_EPOCH),
+        "rss_bytes": _proc_self_status_kb("VmRSS") * 1024,
+        "peak_rss_bytes": _proc_self_status_kb("VmHWM") * 1024,
+        "gc": {
+            "counts": list(gc.get_count()),
+            "collections": [s.get("collections", 0) for s in gc.get_stats()],
+        },
+        "threads": threading.active_count(),
+        "sync_state": REGISTRY.gauge("sync_state").value(),
+        "workers_total": workers,
+        "workers_busy": busy,
+        "worker_busy_ratio": (busy / workers) if workers else 0.0,
+        "trace_ring_size": REGISTRY.gauge("trace_collector_ring_size").value(),
+        "profiler": {
+            "running": PROFILER.running,
+            "samples": PROFILER.samples_total,
+        },
+        "system": system_health().to_dict(),
+    }
 
 
 def observe_system_health(registry=None):

@@ -35,13 +35,41 @@ import random
 import threading
 from dataclasses import dataclass
 
-from ...metrics import inc_counter, set_distribution, set_gauge
+from ...metrics import REGISTRY, inc_counter, set_distribution, set_gauge
 from ...utils.logging import get_logger
 from . import frames as F
 from .mcache import MessageCache
 from .score import PeerScore, PeerScoreParams, PeerScoreThresholds
 
 log = get_logger("gossipsub")
+
+# Mesh observability (the reference's gossipsub_mesh_peers family):
+# per-topic mesh-size gauges are updated every heartbeat; the fixed
+# topic kinds are registered eagerly at zero so dashboards (and
+# conftest) see the series before the first mesh forms — subnet topics
+# (beacon_attestation_<n>, …) appear on their first heartbeat.
+for _kind in (
+    "beacon_block",
+    "beacon_aggregate_and_proof",
+    "voluntary_exit",
+    "proposer_slashing",
+    "attester_slashing",
+):
+    set_gauge("gossipsub_mesh_peers", 0, topic=_kind)
+
+#: peer-score distribution as a HISTOGRAM: every peer's score observed
+#: once per heartbeat. The min/p50/max gauges (set_distribution below)
+#: churn with mesh membership; the cumulative buckets don't forget, so
+#: rate() over them gives the score distribution over a time window —
+#: the signal the event-driven-node work needs to see a slow graylist
+#: slide. Buckets track the v1.1 thresholds (-80 graylist / -60 publish
+#: / -40 gossip) plus a positive-score ladder.
+_PEER_SCORE_HIST = REGISTRY.histogram(
+    "gossipsub_peer_score_distribution",
+    "per-peer gossipsub score, observed once per heartbeat per peer",
+    buckets=(-80.0, -60.0, -40.0, -20.0, -10.0, -5.0, -1.0, 0.0,
+             1.0, 5.0, 10.0, 20.0, 50.0, 100.0),
+)
 
 
 @dataclass
@@ -584,6 +612,8 @@ class GossipsubBehaviour:
                     )
             if scores:
                 set_distribution("gossipsub_peer_score", scores.values())
+                for v in scores.values():
+                    _PEER_SCORE_HIST.observe(v)
         self._flush(out)
 
     # -- owner accessors -------------------------------------------------

@@ -15,8 +15,9 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 
-from ..metrics import inc_counter
+from ..metrics import REGISTRY, inc_counter
 from ..utils.snappy import compress, decompress
 from . import messages as M
 
@@ -28,6 +29,71 @@ RESP_RATE_LIMITED = 3  # p2p-interface ResourceUnavailable-class refusal
 MAX_PAYLOAD = 1 << 22  # 4 MiB cap (gossip_max_size class bound)
 MAX_REQUEST_BLOCKS = 1024
 MAX_REQUEST_BLOB_SIDECARS = 768  # deneb p2p: 128 blocks × 6 blobs
+
+#: protocol id → short method name for per-method latency metrics (the
+#: `proto.split("/")[-3]` component the request counters already use)
+_RPC_METHODS = {
+    proto: proto.split("/")[-3]
+    for proto in (
+        M.PROTO_STATUS,
+        M.PROTO_PING,
+        M.PROTO_METADATA,
+        M.PROTO_GOODBYE,
+        M.PROTO_BLOCKS_BY_RANGE,
+        M.PROTO_BLOCKS_BY_ROOT,
+        M.PROTO_BLOBS_BY_RANGE,
+        M.PROTO_BLOBS_BY_ROOT,
+    )
+}
+#: request-latency buckets: local-loopback pings are sub-ms, a clamped
+#: 1024-block ByRange stream can take seconds
+_RPC_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0,
+)
+# Per-method request-latency histograms, eagerly registered (conftest
+# asserts the series): server side measures decode→response-complete,
+# client side measures dial/substream-open→last-chunk — the number the
+# sync engine's peer selection would want to rank on.
+_SERVER_SECONDS = {
+    proto: REGISTRY.histogram(
+        # lint: allow(metric-hygiene) -- bounded by the protocol table
+        f"rpc_server_request_seconds_{method}",
+        f"server-side request handling wall time: {method}",
+        buckets=_RPC_LATENCY_BUCKETS,
+    )
+    for proto, method in _RPC_METHODS.items()
+}
+_CLIENT_SECONDS = {
+    proto: REGISTRY.histogram(
+        # lint: allow(metric-hygiene) -- bounded by the protocol table
+        f"rpc_client_request_seconds_{method}",
+        f"client-side request round-trip wall time: {method}",
+        buckets=_RPC_LATENCY_BUCKETS,
+    )
+    for proto, method in _RPC_METHODS.items()
+}
+
+
+class _TimedClientRequest:
+    """Observe dial→last-chunk wall time into the per-method client
+    histogram on exit (failures and refusals included — they are the
+    latency the caller experienced)."""
+
+    __slots__ = ("_proto", "_t0")
+
+    def __init__(self, proto: str):
+        self._proto = proto
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        hist = _CLIENT_SECONDS.get(self._proto)
+        if hist is not None:
+            hist.observe(time.perf_counter() - self._t0)
+        return False
 
 
 class RpcError(RuntimeError):
@@ -214,6 +280,19 @@ class RpcServer:
         return True
 
     def _handle_rpc(self, proto: str, sock):
+        hist = _SERVER_SECONDS.get(proto)
+        if hist is None:
+            self._handle_rpc_inner(proto, sock)
+            return
+        t0 = time.perf_counter()
+        try:
+            self._handle_rpc_inner(proto, sock)
+        finally:
+            # rate-limited and failed requests are observed too: the
+            # latency a peer EXPERIENCES includes our refusals
+            hist.observe(time.perf_counter() - t0)
+
+    def _handle_rpc_inner(self, proto: str, sock):
         inc_counter("rpc_requests_total", protocol=proto.split("/")[-3])
         node = self.node
         if proto == M.PROTO_STATUS:
@@ -357,7 +436,7 @@ class RpcClient:
                 self._mux_conn = None
 
     def _request_one(self, proto: str, payload: bytes) -> bytes:
-        with self._open(proto) as sock:
+        with _TimedClientRequest(proto), self._open(proto) as sock:
             _send_block(sock, payload)
             result = _read_exact(sock, 1)[0]
             data = _recv_block(sock)
@@ -377,7 +456,9 @@ class RpcClient:
         return int(resp.data)
 
     def metadata(self) -> M.MetadataMessage:
-        with self._open(M.PROTO_METADATA) as sock:
+        with _TimedClientRequest(M.PROTO_METADATA), self._open(
+            M.PROTO_METADATA
+        ) as sock:
             # metadata has no request body
             result = _read_exact(sock, 1)[0]
             data = _recv_block(sock)
@@ -395,7 +476,7 @@ class RpcClient:
 
     def _stream_blocks(self, proto: str, payload: bytes, decode_block):
         out = []
-        with self._open(proto) as sock:
+        with _TimedClientRequest(proto), self._open(proto) as sock:
             _send_block(sock, payload)
             while True:
                 try:

@@ -202,7 +202,20 @@ class SyncingChain:
             return
 
     def _process_handler(self, batch: Batch):
-        """Runs on a beacon_processor worker."""
+        """Runs on a beacon_processor worker. The processing phase gets
+        its own `sync_range_batch` trace (phase=process; the download
+        phase's trace lives on the sync-dl thread): segment-import spans
+        nest under it, and the stack profiler attributes the worker's
+        samples to the sync_range_batch root instead of "unattributed" —
+        the submit happens span-less on the state-machine thread, so
+        without this root the copy_context hop carries nothing."""
+        with span(
+            "sync_range_batch", batch=batch.id, start=batch.start_slot,
+            phase="process",
+        ):
+            self._process_batch(batch)
+
+    def _process_batch(self, batch: Batch):
         from ...beacon_chain.chain import BlockError, ChainSegmentResult
 
         chain = self.chain

@@ -15,6 +15,12 @@ the same capability sized to this runtime: context-manager spans that
     (no parent) is delivered to `metrics.trace_collector.COLLECTOR`
     (recent-ring + slowest-K reservoir, Chrome trace-event export at
     `/lighthouse/traces`),
+  * publish themselves in a thread→span registry (`thread_spans()`) on
+    enter/exit so the stack profiler (metrics/profiler) can attribute
+    another thread's samples to its innermost active span — contextvars
+    are not readable cross-thread; `adopt_thread_span` lets the
+    beacon_processor register the SUBMITTING span for a worker-side
+    handler run,
   * and emit one structured log line per span at close
     (`span=<name> parent=<name> ms=<dur>`), rate-limited per span name
     so hot paths don't flood the log.
@@ -32,6 +38,7 @@ Usage:
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import functools
 import itertools
@@ -55,6 +62,44 @@ _last_logged: dict[str, float] = {}
 
 _trace_ids = itertools.count(1)
 
+#: thread ident -> innermost ACTIVE span on that thread. The stack
+#: profiler (metrics/profiler) samples other threads' stacks and needs to
+#: know which span each thread is inside — contextvars are not readable
+#: cross-thread, so spans publish themselves here on __enter__/__exit__.
+#: Each thread writes only its own key (GIL-atomic dict ops); readers
+#: take a snapshot via `thread_spans()`.
+_thread_spans: dict[int, "Span"] = {}
+
+
+def thread_spans() -> dict[int, "Span"]:
+    """Snapshot of the thread→innermost-active-span registry."""
+    return dict(_thread_spans)
+
+
+@contextlib.contextmanager
+def adopt_thread_span(span_obj: "Span | None"):
+    """Attribute this thread's profiler samples to a span that was opened
+    on ANOTHER thread for the duration of the block. The beacon_processor
+    worker hop needs this: a handler runs inside the submitter's copied
+    contextvars Context, so `current_span()` resolves to the submitting
+    span (e.g. a `sync_range_batch` root on the sync thread) — adopting
+    it makes worker samples land under that trace root instead of
+    "unattributed", even between the handler's own spans. Spans the
+    handler opens itself nest over (and then restore) the adoption."""
+    if span_obj is None:
+        yield
+        return
+    ident = threading.get_ident()
+    prev = _thread_spans.get(ident)
+    _thread_spans[ident] = span_obj
+    try:
+        yield
+    finally:
+        if prev is None:
+            _thread_spans.pop(ident, None)
+        else:
+            _thread_spans[ident] = prev
+
 
 def _collect_enabled() -> bool:
     return os.environ.get("LIGHTHOUSE_TPU_TRACE_COLLECT", "1") != "0"
@@ -67,9 +112,11 @@ class Span:
         "parent",
         "children",
         "trace_id",
+        "root_name",
         "tid",
         "t0",
         "_token",
+        "_thread_prev",
         "_collect",
         "duration_s",
     )
@@ -80,10 +127,12 @@ class Span:
         self.parent: Span | None = None
         self.children: list[Span] = []
         self.trace_id: str | None = None
+        self.root_name = name
         self.tid = 0
         self.duration_s: float | None = None
         self.t0 = 0.0
         self._token = None
+        self._thread_prev = None
         self._collect = False
 
     def __enter__(self) -> "Span":
@@ -93,11 +142,18 @@ class Span:
             # read per TRACE, not per span
             self._collect = self.parent._collect
             self.trace_id = self.parent.trace_id
+            # root_name is maintained even with collection off: the stack
+            # profiler buckets samples by trace root regardless
+            self.root_name = self.parent.root_name
         else:
             self._collect = _collect_enabled()
             if self._collect:
                 self.trace_id = f"{next(_trace_ids):012x}"
-        self.tid = threading.get_ident() & 0xFFFF
+        ident = threading.get_ident()
+        self.tid = ident & 0xFFFF
+        # publish as this thread's innermost active span (profiler registry)
+        self._thread_prev = _thread_spans.get(ident)
+        _thread_spans[ident] = self
         self._token = _current.set(self)
         self.t0 = time.perf_counter()
         return self
@@ -105,6 +161,12 @@ class Span:
     def __exit__(self, exc_type, exc, tb):
         self.duration_s = time.perf_counter() - self.t0
         _current.reset(self._token)
+        ident = threading.get_ident()
+        if self._thread_prev is None:
+            _thread_spans.pop(ident, None)
+        else:
+            _thread_spans[ident] = self._thread_prev
+        self._thread_prev = None
         REGISTRY.histogram(
             # hygiene is enforced at span() call sites, not here:
             # lint: allow(metric-hygiene) -- the span machinery itself

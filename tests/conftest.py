@@ -39,8 +39,14 @@ def pytest_sessionstart(session):
     )
     from lighthouse_tpu.crypto import bls  # noqa: F401 — registers counters
     from lighthouse_tpu.metrics import REGISTRY
+    from lighthouse_tpu.metrics import profiler  # noqa: F401 — registers
     from lighthouse_tpu.metrics import trace_collector  # noqa: F401 — registers
+    from lighthouse_tpu.network import rpc  # noqa: F401 — registers rpc series
     from lighthouse_tpu.network import sync  # noqa: F401 — registers sync series
+    from lighthouse_tpu.network.gossipsub import (  # noqa: F401 — registers
+        behaviour,  # mesh gauges + peer-score distribution histogram
+    )
+    from lighthouse_tpu.utils import compile_cache  # noqa: F401 — registers
     from lighthouse_tpu.state_processing import (  # noqa: F401 — registers
         attestation_batch,  # the batch path counter + attestation_apply span
         registry_columns,  # the columns counters + epoch_stage spans
@@ -138,6 +144,27 @@ def pytest_sessionstart(session):
         "beacon_block_head_slot_start_delay_seconds",
         "beacon_attestation_gossip_slot_start_delay_seconds",
         "beacon_aggregate_gossip_slot_start_delay_seconds",
+        # PR 10: the profiler's sample/overrun counters, the compile-cache
+        # counters, the gossip mesh/peer-score series, and the per-method
+        # RPC latency histograms must exist at zero — /lighthouse/profile,
+        # bench --profile, and dashboards read them eagerly
+        'profiler_samples_total{root="block_import"}',
+        'profiler_samples_total{root="sync_range_batch"}',
+        'profiler_samples_total{root="other"}',
+        'profiler_samples_total{root="unattributed"}',
+        "profiler_overrun_total",
+        "compile_cache_hits_total",
+        "compile_cache_misses_total",
+        "compile_cache_compile_seconds_total",
+        'gossipsub_mesh_peers{topic="beacon_block"}',
+        'gossipsub_mesh_peers{topic="beacon_aggregate_and_proof"}',
+        "gossipsub_peer_score_distribution",
+        "rpc_server_request_seconds_status",
+        "rpc_server_request_seconds_beacon_blocks_by_range",
+        "rpc_server_request_seconds_blob_sidecars_by_root",
+        "rpc_client_request_seconds_status",
+        "rpc_client_request_seconds_beacon_blocks_by_range",
+        "rpc_client_request_seconds_metadata",
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"
