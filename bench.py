@@ -1041,10 +1041,10 @@ def _hist_percentiles(buckets, counts, qs=(0.5, 0.9, 0.99)):
     return out
 
 
-def _queue_wait_snapshot():
-    """Per-WorkType (buckets, counts) of the beacon_processor time-in-queue
-    histograms — PR 9's queue observability, consumed as before/after
-    deltas so the bench reports only ITS OWN queue waits."""
+def _hist_snapshot(prefix: str):
+    """Per-WorkType (buckets, counts) of one beacon_processor histogram
+    family — PR 9's queue observability, consumed as before/after deltas
+    so a bench reports only ITS OWN traffic."""
     from lighthouse_tpu.beacon_processor import WorkType
     from lighthouse_tpu.metrics import REGISTRY
 
@@ -1052,10 +1052,15 @@ def _queue_wait_snapshot():
     for t in WorkType:
         kind = t.name.lower()
         buckets, counts, _total, _sum = REGISTRY.histogram(
-            f"beacon_processor_queue_wait_seconds_{kind}"
+            prefix + kind
         ).snapshot()
         out[kind] = (buckets, counts)
     return out
+
+
+def _queue_wait_snapshot():
+    """Time-in-queue (submit → worker pickup) per WorkType."""
+    return _hist_snapshot("beacon_processor_queue_wait_seconds_")
 
 
 def _queue_wait_percentiles(before, after):
@@ -1158,6 +1163,190 @@ def bench_sync_catchup(jax):
     }
 
 
+def _work_run_snapshot():
+    """Handler wall time per WorkType — the import-latency side of the
+    queue story."""
+    return _hist_snapshot("beacon_processor_work_seconds_")
+
+
+def bench_gossip_soak(jax):
+    """Event-driven node under storm: N faulty peers sustain an
+    attestation + aggregate flood (decodable, unknown-root — the worst
+    honest-looking spam) at a fresh node WHILE it range-syncs the full
+    chain from an honest peer. Headline: catch-up blocks/sec under
+    flood; vs_baseline is the fraction of the same run's flood-free
+    catch-up rate retained (1.0 = the flood cost nothing). The JSON
+    carries the robustness evidence: drop counts (processor backpressure
+    + reprocess caps — shed, not hung), queue-wait AND handler-run
+    percentiles per WorkType lane, and the reprocess counters."""
+    import threading
+    from dataclasses import replace
+
+    from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.metrics import REGISTRY
+    from lighthouse_tpu.network import NetworkService
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    bls.set_backend("fake_crypto")  # measures the pipeline, not BLS
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    slots = 2 * E.SLOTS_PER_EPOCH if SMOKE else 4 * E.SLOTS_PER_EPOCH
+    flooders = 2
+    serve = BeaconChainHarness(spec, E, validator_count=16)
+    serve.extend_chain(slots, attest=False)
+    na = NetworkService(serve.chain, heartbeat_interval=None).start()
+    tip = serve.chain.head_state.slot
+    template = serve.make_unaggregated_attestations(
+        tip, serve.chain.head_root
+    )[0]
+    t = serve.chain.types
+    garbage_roots = [bytes([0x70 + j]) * 32 for j in range(8)]
+
+    def one_catchup(flood: bool):
+        b = BeaconChainHarness(spec, E, validator_count=16)
+        nb = NetworkService(b.chain, heartbeat_interval=None).start()
+        nfs = []
+        stop_flood = threading.Event()
+        sent = [0]
+
+        def flood_loop(nf, lane):
+            i = 0
+            while not stop_flood.is_set():
+                att = template.copy()
+                att.data.beacon_block_root = garbage_roots[
+                    i % len(garbage_roots)
+                ]
+                att.signature = (lane * (1 << 40) + i).to_bytes(
+                    8, "little"
+                ) + bytes(88)
+                if i % 4 == 3:
+                    agg = t.SignedAggregateAndProof(
+                        message=t.AggregateAndProof(
+                            aggregator_index=0,
+                            aggregate=att,
+                            selection_proof=b"\x01" * 96,
+                        ),
+                        signature=b"\x02" * 96,
+                    )
+                    nf.gossip.publish(nf.topic_aggregate, agg.serialize())
+                else:
+                    nf.gossip.publish(
+                        nf.topic_att, t.Attestation.serialize_value(att)
+                    )
+                sent[0] += 1
+                i += 1
+                time.sleep(0.001)  # sustained flood, not a GIL vice
+
+        threads = []
+        try:
+            b.slot_clock.set_slot(tip)
+            peer = nb.connect("127.0.0.1", na.port)
+            if flood:
+                for lane in range(flooders):
+                    h = BeaconChainHarness(spec, E, validator_count=16)
+                    nf = NetworkService(h.chain, heartbeat_interval=None).start()
+                    nf.connect("127.0.0.1", nb.port)
+                    nfs.append(nf)
+                threads = [
+                    threading.Thread(
+                        target=flood_loop, args=(nf, lane), daemon=True
+                    )
+                    for lane, nf in enumerate(nfs)
+                ]
+                for th in threads:
+                    th.start()
+            t0 = time.perf_counter()
+            imported = nb.sync.sync_with(peer)
+            dt = time.perf_counter() - t0
+            assert imported == slots, f"imported {imported}/{slots}"
+            return dt, sent[0]
+        finally:
+            stop_flood.set()
+            for th in threads:
+                th.join(timeout=5)
+            for nf in nfs:
+                nf.stop()
+            nb.stop()
+
+    def counters():
+        out = {}
+        for name, labels in (
+            ("reprocess_held_total", {}),
+            ("reprocess_drained_total", {}),
+            ("reprocess_expired_total", {"reason": "root_cap"}),
+            ("reprocess_expired_total", {"reason": "total_cap"}),
+            ("reprocess_expired_total", {"reason": "shutdown"}),
+            ("gossip_ignored_total", {}),
+            ("gossip_internal_error_total", {}),
+        ):
+            key = name + (
+                f"[{next(iter(labels.values()))}]" if labels else ""
+            )
+            out[key] = REGISTRY.counter(name).value(**labels)
+        for kind in ("gossip_attestation", "gossip_aggregate"):
+            out[f"dropped[{kind}]"] = REGISTRY.counter(
+                "beacon_processor_dropped_total"
+            ).value(kind=kind)
+        return out
+
+    def spread(samples):
+        return {
+            "median_s": statistics.median(samples),
+            "min_s": min(samples),
+            "max_s": max(samples),
+            "trials": len(samples),
+        }
+
+    before = counters()
+    qw_before, run_before = _queue_wait_snapshot(), _work_run_snapshot()
+    flood_times, flood_sent = [], 0
+    try:
+        for i in range(3):
+            dt, sent = one_catchup(flood=True)
+            flood_times.append(dt)
+            flood_sent += sent
+            _partial(trial=i + 1, of=3, s=round(dt, 4), flood_msgs=sent)
+        after = counters()
+        queue_wait = _queue_wait_percentiles(qw_before, _queue_wait_snapshot())
+        handler_run = _queue_wait_percentiles(run_before, _work_run_snapshot())
+        clean_times = []
+        for i in range(3):
+            dt, _ = one_catchup(flood=False)
+            clean_times.append(dt)
+            _partial(control_trial=i + 1, of=3, s=round(dt, 4))
+    finally:
+        # a failed trial must not leak the serve node's server/worker
+        # threads into the rest of the bench process
+        na.stop()
+    med, med_clean = statistics.median(flood_times), statistics.median(clean_times)
+    return {
+        "metric": "gossip_soak",
+        "value": round(slots / med, 1),
+        "unit": (
+            f"blocks/sec (range sync under attestation/aggregate flood, "
+            f"{flooders} faulty peers)"
+        ),
+        # fraction of the flood-free catch-up rate retained under storm
+        # (same run, same topology minus the flooders); 1.0 = free
+        "vs_baseline": round(med_clean / med, 3),
+        "baseline_control": "same-run flood-free catch-up (rate retained)",
+        "config": {
+            "slots": slots,
+            "validators": 16,
+            "spec": "minimal",
+            "flooders": flooders,
+            "flood_messages_total": flood_sent,
+            "clean_blocks_per_sec": round(slots / med_clean, 1),
+        },
+        "counters": {k: round(after[k] - before[k], 1) for k in after},
+        "queue_wait": queue_wait,
+        "handler_run": handler_run,
+        "spread": spread(flood_times),
+        "control_spread": spread(clean_times),
+    }
+
+
 _METRICS = {
     "merkle": bench_merkle,
     "pairing": bench_pairing,
@@ -1169,6 +1358,7 @@ _METRICS = {
     "kzg": bench_kzg,
     "bls": bench_bls,
     "sync_catchup": bench_sync_catchup,
+    "gossip_soak": bench_gossip_soak,
     "attestation_batch": bench_attestation_batch,
 }
 
@@ -1317,6 +1507,9 @@ def main():
         "epoch_reroot": 300,  # 1M mass-churn full-rebuild re-roots
         "kzg": 240,  # metric 4; compile served by the warmed cache
         "sync_catchup": 120,  # fake_crypto loopback pair; no compiles
+        # 3 flood trials (2 flooder services each) + 3 flood-free
+        # controls; fake_crypto, no compiles
+        "gossip_soak": 180,
         # 16k-validator fixture + 3 columnar trials + 2 scalar-oracle
         # controls (the controls dominate: ~65k per-validator Python
         # iterations each)

@@ -36,6 +36,13 @@ and milhouse `&mut` discipline, as a linter instead of a type system):
   outstanding handles — the PR 6 rule documented at
   accessors._fresh_columns).
 
+* ``queue-discipline`` — callables registered to run on a socket reader
+  thread (`gossip.subscribe` handlers, `gossip.subscribe_queued` decode
+  steps) must not call chain state transitions (``chain.process_*``,
+  ``per_block_processing``); that work must ride a beacon_processor
+  lane (the `process=` step of ``subscribe_queued``) so gossip storms
+  back up drop-counted queues instead of sockets.
+
 Suppression: ``# lint: allow(rule[, rule]) -- reason`` on the violating
 line or the line directly above it. ``# lint: allow-file(rule) -- reason``
 within the first 20 lines suppresses a rule for the whole file. A
@@ -55,6 +62,7 @@ RULES = (
     "fork-safety",
     "dirty-channel",
     "metric-hygiene",
+    "queue-discipline",
 )
 
 _ALLOW_RE = re.compile(
@@ -128,6 +136,29 @@ _METRIC_NAME_CALLS = {
 }
 #: registry methods whose first argument is a collector name
 _REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+
+# -- queue-discipline vocabulary ---------------------------------------------
+
+#: gossip registration methods -> index of the positional arg that runs
+#: INLINE on the socket reader thread (`subscribe(topic, handler)` /
+#: `subscribe_queued(topic, work_type, decode, ...)`); the queued
+#: `process=`/`process_batch=` callables are exempt by design — they run
+#: on beacon_processor workers
+_GOSSIP_REGISTER_METHODS = {"subscribe": (1, "handler"), "subscribe_queued": (2, "decode")}
+#: chain state-transition entry points a reader-thread callable must
+#: never reach — they belong behind BeaconProcessor.submit
+_STATE_TRANSITION_CALLS = {
+    "process_block",
+    "process_chain_segment",
+    "process_attestation_batch",
+    "process_aggregate",
+    "process_voluntary_exit",
+    "process_proposer_slashing",
+    "process_attester_slashing",
+    "process_sync_committee_message",
+    "process_blob_sidecars",
+    "per_block_processing",
+}
 
 # -- dirty-channel vocabulary ------------------------------------------------
 
@@ -720,6 +751,157 @@ def _check_dirty_channel(tree: ast.Module, path: str) -> list[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# Rule: queue-discipline
+# ---------------------------------------------------------------------------
+
+
+def _mentions_gossip(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return "gossip" in node.id.lower()
+    if isinstance(node, ast.Attribute):
+        return "gossip" in node.attr.lower() or _mentions_gossip(node.value)
+    if isinstance(node, ast.Call):
+        return _mentions_gossip(node.func)
+    return False
+
+
+def _all_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    """name -> FunctionDef for module functions AND every class method
+    (gossip handlers are almost always methods: `self._on_gossip_x`)."""
+    out = dict(_module_functions(tree))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef):
+                    out.setdefault(sub.name, sub)
+    return out
+
+
+def _scan_handler(
+    fn: ast.FunctionDef,
+    funcs: dict[str, ast.FunctionDef],
+    visited: set[str],
+) -> list[tuple[int, str]]:
+    """(line, call name) for state-transition calls reachable from `fn`
+    through same-module callees (methods resolved by name, one level of
+    nesting at a time)."""
+    if fn.name in visited:
+        return []
+    visited.add(fn.name)
+    findings = []
+    callees: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = None
+        if isinstance(f, ast.Attribute):
+            name = f.attr
+        elif isinstance(f, ast.Name):
+            name = f.id
+        if name in _STATE_TRANSITION_CALLS:
+            findings.append((node.lineno, name))
+        elif name is not None:
+            callees.add(name)
+    for name in callees:
+        callee = funcs.get(name)
+        if callee is not None:
+            findings.extend(_scan_handler(callee, funcs, visited))
+    return findings
+
+
+def _handler_aliases(tree: ast.Module) -> dict[str, str]:
+    """Local-name aliases of functions/methods anywhere in the module
+    (`decode = self._decode_x` / `h = on_block`): a handler registered
+    through an alias must still resolve to its definition — a silently
+    skipped alias would be a hole in the gate (found by review: the
+    package's own attestation decode briefly registered through one)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Name):
+            continue
+        v = node.value
+        if isinstance(v, ast.Attribute):
+            out[t.id] = v.attr
+        elif isinstance(v, ast.Name):
+            out[t.id] = v.id
+    return out
+
+
+def _check_queue_discipline(tree: ast.Module, path: str) -> list[Violation]:
+    """Callables registered to run on a socket reader thread — the
+    `handler` of `gossip.subscribe` and the `decode` of
+    `gossip.subscribe_queued` — must not execute chain state transitions
+    (`chain.process_*`, `per_block_processing`): that work belongs on a
+    beacon_processor lane via `subscribe_queued`'s `process=` step, so a
+    gossip storm backs up queues (drop-counted) instead of sockets."""
+    out: list[Violation] = []
+    funcs = _all_functions(tree)
+    aliases = _handler_aliases(tree)
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _GOSSIP_REGISTER_METHODS
+            and _mentions_gossip(node.func.value)
+        ):
+            continue
+        pos, kw_name = _GOSSIP_REGISTER_METHODS[node.func.attr]
+        handler = None
+        if len(node.args) > pos:
+            handler = node.args[pos]
+        else:
+            for kw in node.keywords:
+                if kw.arg == kw_name:
+                    handler = kw.value
+        if handler is None:
+            continue
+        if isinstance(handler, ast.Lambda):
+            hits = [
+                (n.lineno, n.func.attr)
+                for n in ast.walk(handler)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _STATE_TRANSITION_CALLS
+            ]
+            name = "<lambda>"
+        else:
+            if isinstance(handler, ast.Attribute):
+                name = handler.attr
+            elif isinstance(handler, ast.Name):
+                name = handler.id
+            else:
+                continue
+            fn = funcs.get(name)
+            # follow local aliases (`decode = self._decode_x`) until a
+            # definition resolves — bounded by the alias map size
+            seen_aliases: set[str] = set()
+            while fn is None and name in aliases and name not in seen_aliases:
+                seen_aliases.add(name)
+                name = aliases[name]
+                fn = funcs.get(name)
+            if fn is None:
+                continue
+            hits = _scan_handler(fn, funcs, set())
+        for line, call in hits:
+            out.append(
+                Violation(
+                    path,
+                    node.lineno,
+                    "queue-discipline",
+                    f"gossip {kw_name} `{name}` reaches `{call}` "
+                    f"(line {line}) on the socket reader thread — route "
+                    f"state-transition work through BeaconProcessor.submit "
+                    f"(subscribe_queued's process step)",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Rule: metric-hygiene
 # ---------------------------------------------------------------------------
 
@@ -803,6 +985,7 @@ _CHECKS = (
     _check_fork_safety,
     _check_dirty_channel,
     _check_metric_hygiene,
+    _check_queue_discipline,
 )
 
 

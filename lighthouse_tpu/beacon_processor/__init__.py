@@ -32,7 +32,11 @@ MAX_GOSSIP_AGGREGATE_BATCH_SIZE = 64
 
 class WorkType(enum.IntEnum):
     """Queue kinds, priority order (low value = drained first) — the Work
-    enum's ~32 variants collapse to the kinds this node implements."""
+    enum's ~32 variants collapse to the kinds this node implements. Every
+    gossip kind has its own lane (the event-driven-node refactor): blocks
+    and sidecars outrank aggregates, which outrank raw attestations, which
+    outrank the pool-feeding operation topics — so a gossip storm degrades
+    the cheap lanes first while block import keeps draining."""
 
     CHAIN_SEGMENT = 0
     #: lookup-recovered blocks (Work::RpcBlock): ahead of gossip blocks —
@@ -43,8 +47,13 @@ class WorkType(enum.IntEnum):
     GOSSIP_AGGREGATE = 4
     GOSSIP_ATTESTATION = 5
     UNKNOWN_BLOCK_ATTESTATION = 6
-    API_REQUEST = 7
-    BACKFILL_SYNC = 8
+    UNKNOWN_BLOCK_AGGREGATE = 7
+    GOSSIP_SYNC_COMMITTEE = 8
+    API_REQUEST = 9
+    GOSSIP_VOLUNTARY_EXIT = 10
+    GOSSIP_PROPOSER_SLASHING = 11
+    GOSSIP_ATTESTER_SLASHING = 12
+    BACKFILL_SYNC = 13
 
 
 _QUEUE_BOUNDS = {
@@ -55,7 +64,12 @@ _QUEUE_BOUNDS = {
     WorkType.GOSSIP_AGGREGATE: 4096,
     WorkType.GOSSIP_ATTESTATION: 16384,
     WorkType.UNKNOWN_BLOCK_ATTESTATION: 8192,
+    WorkType.UNKNOWN_BLOCK_AGGREGATE: 4096,
+    WorkType.GOSSIP_SYNC_COMMITTEE: 4096,
     WorkType.API_REQUEST: 1024,
+    WorkType.GOSSIP_VOLUNTARY_EXIT: 1024,
+    WorkType.GOSSIP_PROPOSER_SLASHING: 512,
+    WorkType.GOSSIP_ATTESTER_SLASHING: 512,
     WorkType.BACKFILL_SYNC: 64,
 }
 
@@ -63,6 +77,9 @@ _BATCHED = {
     WorkType.GOSSIP_ATTESTATION: MAX_GOSSIP_ATTESTATION_BATCH_SIZE,
     WorkType.GOSSIP_AGGREGATE: MAX_GOSSIP_AGGREGATE_BATCH_SIZE,
 }
+#: kinds whose handlers receive list[item] (public: the gossip router
+#: picks its runner shape off this)
+BATCHED_WORK_TYPES = frozenset(_BATCHED)
 
 # Queue observability (the reference's beacon_processor_* metric family):
 # time-in-queue and handler-run histograms per WorkType, eagerly
@@ -103,6 +120,33 @@ _BUSY_SECONDS = REGISTRY.counter(
     "cumulative worker-busy wall time; ratio = rate(busy_seconds) / workers",
 )
 _BUSY_SECONDS.inc(0)
+# shutdown accounting: queued work explicitly abandoned (not silently
+# dropped) when the processor stops before draining
+_ABANDONED = REGISTRY.counter(
+    "beacon_processor_abandoned_total",
+    "work events abandoned in-queue at shutdown, by kind",
+)
+for _t in WorkType:
+    _ABANDONED.inc(0, kind=_t.name.lower())
+# ReprocessQueue observability (work_reprocessing_queue.rs metric family):
+# held = entries parked, drained = entries re-submitted (block arrived /
+# slot started), expired = entries dropped (slot expiry, caps, shutdown)
+_REPROCESS_HELD = REGISTRY.counter(
+    "reprocess_held_total", "work events parked in the reprocess queue"
+)
+_REPROCESS_HELD.inc(0)
+_REPROCESS_DRAINED = REGISTRY.counter(
+    "reprocess_drained_total",
+    "held work events re-submitted to the processor",
+)
+_REPROCESS_DRAINED.inc(0)
+_REPROCESS_EXPIRED = REGISTRY.counter(
+    "reprocess_expired_total",
+    "held work events dropped without re-firing, by reason",
+)
+for _reason in ("slot", "root_cap", "total_cap", "shutdown"):
+    _REPROCESS_EXPIRED.inc(0, reason=_reason)
+set_gauge("reprocess_queue_depth", 0)
 
 
 def _run_in_ctx(ctx, handler, arg):
@@ -203,7 +247,11 @@ class BeaconProcessor:
         spans attach under whatever span submitted the work."""
         ev = WorkEvent(work_type, item, handler)
         with self._cv:
-            ok = self._queues.push(ev)
+            # a post-shutdown submit (a joining-but-still-live slot tick
+            # or sync loop racing stop()) must refuse: the manager is
+            # gone, so a push would sit uncounted forever — refusal rides
+            # the same drop counter as backpressure
+            ok = False if self._shutdown else self._queues.push(ev)
             if ok:
                 # stamped only AFTER a successful push — a dropped event
                 # under backpressure must not pay the context copy — but
@@ -232,7 +280,33 @@ class BeaconProcessor:
             with self._cv:
                 while not self._queues.__len__() and not self._shutdown:
                     self._cv.wait(timeout=0.1)
-                if self._shutdown and not len(self._queues):
+                if self._shutdown:
+                    # shutdown abandons the backlog EXPLICITLY: stop must
+                    # not block behind a storm's queued work, and the drop
+                    # is counted, never silent (graceful-shutdown audit)
+                    abandoned = {
+                        t: len(q)
+                        for t, q in self._queues.by_type.items()
+                        if q
+                    }
+                    for q in self._queues.by_type.values():
+                        q.clear()
+                else:
+                    abandoned = None
+                if abandoned is not None:
+                    for t, n in abandoned.items():
+                        _ABANDONED.inc(n, kind=t.name.lower())
+                    # the depth gauges are process-global (shared
+                    # REGISTRY): leaving them frozen at the pre-shutdown
+                    # backlog would show a phantom queue for the rest of
+                    # the process (benches run many processors serially)
+                    for t in WorkType:
+                        set_gauge(
+                            "beacon_processor_queue_depth_by_kind",
+                            0,
+                            kind=t.name.lower(),
+                        )
+                    set_gauge("beacon_processor_queue_depth", 0)
                     break
                 t, batch = self._queues.pop_next()
                 # only the drained kind's depth changed on this pop (the
@@ -330,35 +404,141 @@ class BeaconProcessor:
             w.join(timeout=2)
 
 
+#: held entries per unknown root: one hostile root must not monopolize
+#: the queue (work_reprocessing_queue.rs caps per-root attestations)
+REPROCESS_PER_ROOT_CAP = 64
+#: total held entries across every root + slot bucket
+REPROCESS_TOTAL_CAP = 8192
+#: slots a held entry survives past its stamped slot before the slot
+#: tick expires it (the reference holds queued attestations for roughly
+#: one slot; two here — gossip + lookup recovery both get a full chance)
+REPROCESS_EXPIRY_SLOTS = 2
+
+
 class ReprocessQueue:
     """Early/unknown-parent work held for retry (work_reprocessing_queue.rs):
     attestations for unknown blocks re-fire when the block arrives; early
-    work re-fires at its slot."""
+    work re-fires at its slot. BOUNDED: per-root and total caps refuse new
+    work when full (counted, like the processor's backpressure), and every
+    entry is slot-stamped so the NetworkService's slot tick expires work
+    whose block never arrived — held work can no longer leak forever."""
 
-    def __init__(self):
-        self._by_block_root: dict[bytes, list[WorkEvent]] = {}
+    def __init__(
+        self,
+        per_root_cap: int = REPROCESS_PER_ROOT_CAP,
+        total_cap: int = REPROCESS_TOTAL_CAP,
+        expiry_slots: int = REPROCESS_EXPIRY_SLOTS,
+    ):
+        self.per_root_cap = per_root_cap
+        self.total_cap = total_cap
+        self.expiry_slots = expiry_slots
+        #: root -> [(slot, ev)] — slot is the work's anchoring slot
+        #: (attestation slot), None = never slot-expired (caps still apply)
+        self._by_block_root: dict[bytes, list[tuple[int | None, WorkEvent]]] = {}
         self._by_slot: dict[int, list[WorkEvent]] = {}
+        self._total = 0
         self._lock = threading.Lock()
 
-    def hold_for_block(self, block_root: bytes, ev: WorkEvent):
-        with self._lock:
-            self._by_block_root.setdefault(block_root, []).append(ev)
+    def _set_depth(self):
+        set_gauge("reprocess_queue_depth", self._total)
 
-    def hold_for_slot(self, slot: int, ev: WorkEvent):
+    def hold_for_block(
+        self, block_root: bytes, ev: WorkEvent, slot: int | None = None
+    ) -> bool:
+        """Park work until `block_root` imports. False (and an expired
+        count) when a cap refuses it — callers treat that as load shed."""
         with self._lock:
-            self._by_slot.setdefault(slot, []).append(ev)
+            if self._total >= self.total_cap:
+                reason = "total_cap"
+            else:
+                held = self._by_block_root.setdefault(block_root, [])
+                if len(held) >= self.per_root_cap:
+                    reason = "root_cap"
+                else:
+                    held.append((slot, ev))
+                    self._total += 1
+                    _REPROCESS_HELD.inc()
+                    self._set_depth()
+                    return True
+        _REPROCESS_EXPIRED.inc(reason=reason)
+        return False
+
+    def hold_for_slot(self, slot: int, ev: WorkEvent) -> bool:
+        with self._lock:
+            if self._total >= self.total_cap:
+                pass
+            else:
+                self._by_slot.setdefault(slot, []).append(ev)
+                self._total += 1
+                _REPROCESS_HELD.inc()
+                self._set_depth()
+                return True
+        _REPROCESS_EXPIRED.inc(reason="total_cap")
+        return False
 
     def block_imported(self, block_root: bytes, processor: BeaconProcessor):
         with self._lock:
-            evs = self._by_block_root.pop(block_root, [])
-        for ev in evs:
+            entries = self._by_block_root.pop(block_root, [])
+            self._total -= len(entries)
+            self._set_depth()
+        for _slot, ev in entries:
             processor.submit(ev.work_type, ev.item, ev.handler)
-        return len(evs)
+        if entries:
+            _REPROCESS_DRAINED.inc(len(entries))
+        return len(entries)
 
     def slot_started(self, slot: int, processor: BeaconProcessor):
         with self._lock:
             due = [s for s in self._by_slot if s <= slot]
             evs = [ev for s in due for ev in self._by_slot.pop(s)]
+            self._total -= len(evs)
+            self._set_depth()
         for ev in evs:
             processor.submit(ev.work_type, ev.item, ev.handler)
+        if evs:
+            _REPROCESS_DRAINED.inc(len(evs))
         return len(evs)
+
+    def expire(self, current_slot: int) -> int:
+        """Drop held-for-block entries whose stamped slot is more than
+        `expiry_slots` behind the wall clock — the block they wait on is
+        not coming (or arrived under a different root). Driven by the
+        NetworkService slot tick."""
+        expired = 0
+        with self._lock:
+            for root in list(self._by_block_root):
+                kept = []
+                for slot, ev in self._by_block_root[root]:
+                    if (
+                        slot is not None
+                        and slot + self.expiry_slots < current_slot
+                    ):
+                        expired += 1
+                    else:
+                        kept.append((slot, ev))
+                if kept:
+                    self._by_block_root[root] = kept
+                else:
+                    del self._by_block_root[root]
+            self._total -= expired
+            self._set_depth()
+        if expired:
+            _REPROCESS_EXPIRED.inc(expired, reason="slot")
+        return expired
+
+    def clear(self, reason: str = "shutdown") -> int:
+        """Abandon everything held (NetworkService.stop): counted under
+        `reprocess_expired_total{reason=shutdown}`, never silent."""
+        with self._lock:
+            n = self._total
+            self._by_block_root.clear()
+            self._by_slot.clear()
+            self._total = 0
+            self._set_depth()
+        if n:
+            _REPROCESS_EXPIRED.inc(n, reason=reason)
+        return n
+
+    def __len__(self):
+        with self._lock:
+            return self._total

@@ -52,6 +52,10 @@ class ClientConfig:
     # "dev" = insecure dev setup (tests/devnets). With bls_backend="tpu"
     # the engine runs its MSM/pairing/Fr kernels on device.
     kzg: str = "none"
+    # autonomous sync service poll cadence (network/sync/service.py):
+    # the node watches peer Statuses and catches itself up — no caller
+    # ever invokes sync_to_head. None disables (tests drive sync by hand).
+    sync_service_interval: float | None = 0.5
 
 
 class Client:
@@ -204,7 +208,10 @@ class ClientBuilder:
                 )
                 transport = NoiseTransport(identity)
             c.network = NetworkService(
-                c.chain, port=cfg.network_port, transport=transport
+                c.chain,
+                port=cfg.network_port,
+                transport=transport,
+                sync_service_interval=cfg.sync_service_interval,
             )
         # http (identity/peers routes read the network when present)
         if cfg.http_port is not None:

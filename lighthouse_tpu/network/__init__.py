@@ -25,21 +25,30 @@ sync/manager.rs + range_sync/ + backfill_sync/ + block_lookups/)."""
 
 from __future__ import annotations
 
+import queue
 import socket
 import threading
 import time
 from dataclasses import dataclass, field
 
-from ..beacon_processor import BeaconProcessor, ReprocessQueue, WorkEvent, WorkType
-from ..metrics import inc_counter, set_gauge
+from ..beacon_processor import (
+    BATCHED_WORK_TYPES,
+    BeaconProcessor,
+    ReprocessQueue,
+    WorkEvent,
+    WorkType,
+)
+from ..metrics import REGISTRY, inc_counter, set_gauge
 from ..utils.logging import get_logger
 from . import messages as M
 from .gossipsub import (
+    DEFERRED,
     FrameError,
     GossipsubBehaviour,
     beacon_score_params,
     beacon_score_thresholds,
     decode_frame,
+    short_topic as _short_topic,
 )
 from .rpc import (
     RpcClient,
@@ -66,6 +75,54 @@ BAN_THRESHOLD = -40.0
 MAX_SCORE = 100.0
 BAN_DURATION = 3600.0  # bans expire (peerdb's ban period); entry then drops
 _GOSSIP_IO_TIMEOUT = 30.0  # bounds send stalls AND idle reader probes
+
+# gossip outcome accounting (reference Accept/Ignore/Reject semantics):
+# rejects downscore the forwarder; ignores and internal errors never do
+REGISTRY.counter(
+    "gossip_internal_error_total",
+    "gossip handlers that failed on OUR side (store fault, bug) — "
+    "logged and not relayed, but the forwarding peer is NOT penalized",
+).inc(0)
+REGISTRY.counter(
+    "gossip_ignored_total",
+    "gossip messages neither relayed nor penalized (unknown root/parent, "
+    "ordering races, reprocess parking)",
+).inc(0)
+REGISTRY.counter(
+    "gossip_relay_dropped_total",
+    "accepted messages whose mesh relay was shed (relay queue full) — "
+    "processed locally, not re-forwarded",
+).inc(0)
+
+
+class GossipIgnore(Exception):
+    """A gossip message we can't act on through no fault of the forwarder
+    (reference Ignore): unknown root/parent being recovered, work parked
+    in the reprocess queue. Not relayed, not penalized."""
+
+
+@dataclass
+class _GossipWork:
+    """One decoded gossip message riding a beacon_processor lane: enough
+    context for the queued handler to complete the deferred relay /
+    downscore decision when validation finishes."""
+
+    topic: str
+    item: object
+    data: bytes
+    origin: str
+
+
+@dataclass
+class _QueuedTopic:
+    """Registration record for a queue-routed gossip topic."""
+
+    work_type: WorkType
+    decode: object  # data -> item (reader thread; cheap, reject-on-raise)
+    process: object  # item -> None (worker thread; raises to classify)
+    #: optional whole-drained-batch processor for batched WorkTypes:
+    #: items -> list[Exception | None] (one outcome per item, in order)
+    process_batch: object = None
 
 
 @dataclass
@@ -209,10 +266,24 @@ class GossipRouter:
     The flood-publish stand-in graduated (network/gossipsub/): the
     behaviour owns mesh membership, the mcache, scoring and dedup; this
     router supplies its transport (peer sockets via the PeerManager), its
-    validation (the per-topic chain handlers, whose accept/reject drives
-    BOTH gossipsub scoring and the PeerManager's ban scores), and its
-    peer-exchange records. Publish/subscribe signatures are unchanged —
-    the rest of the node doesn't know the fan-out got a mesh."""
+    validation, and its peer-exchange records.
+
+    Validation is QUEUE-ROUTED (the event-driven-node refactor): a topic
+    registered via `subscribe_queued` runs only a thin decode step on the
+    socket reader thread — the chain-touching process step rides its own
+    beacon_processor WorkType lane, so reader threads never block on
+    state transitions, priority ordering (blocks before attestations)
+    holds under storm, and full queues shed load through the processor's
+    drop-counted backpressure instead of stalling sockets. The
+    validate-then-forward contract survives: `_deliver` returns the
+    gossipsub DEFERRED sentinel and the queued handler reports the
+    outcome via `behaviour.complete_validation`, which performs exactly
+    the relay/score steps the inline path would have. Outcomes follow the
+    reference Accept/Ignore/Reject split — only Rejects (the chain's
+    ValueError validation family) cost the forwarder score; internal faults are
+    logged and counted (`gossip_internal_error_total`) without penalizing
+    an innocent peer. Plain `subscribe` keeps the inline contract for
+    relay-only/auxiliary topics."""
 
     def __init__(
         self,
@@ -223,6 +294,24 @@ class GossipRouter:
     ):
         self.service = service
         self._handlers: dict[str, object] = {}
+        self._queued: dict[str, _QueuedTopic] = {}
+        #: one runner object per WorkType (NOT per topic): the processor
+        #: coalesces batched kinds by handler identity, so all 64
+        #: attestation subnets must share one runner to share one batch
+        self._runners: dict[WorkType, object] = {}
+        # deferred-Accept relays ride their own thread: the mesh forward
+        # is a blocking socket send (peer.lock, 30 s I/O timeout) and
+        # must not wedge the beacon_processor's scarce workers behind one
+        # stalled peer — a full relay queue sheds the FORWARD only
+        # (counted; the message was already processed locally)
+        self._relay_q: queue.Queue = queue.Queue(maxsize=1024)
+        self._relay_stop = threading.Event()
+        self._relay_thread = threading.Thread(
+            target=self._relay_loop,
+            daemon=True,
+            name=f"gossip-relay-{service.port}",
+        )
+        self._relay_thread.start()
         domain = service.spec.message_domain_valid_snappy
         self.behaviour = GossipsubBehaviour(
             send=self._send_frame,
@@ -235,7 +324,35 @@ class GossipRouter:
         )
 
     def subscribe(self, topic: str, handler):
+        """Inline-validated subscription (legacy contract): handler runs
+        on the reader thread; raising rejects. Chain-touching handlers
+        belong on `subscribe_queued` (the queue-discipline lint rule
+        enforces this — handlers here must not call chain.process_*)."""
         self._handlers[topic] = handler
+        self.behaviour.subscribe(topic)
+
+    def subscribe_queued(
+        self,
+        topic: str,
+        work_type: WorkType,
+        decode,
+        process=None,
+        process_batch=None,
+    ):
+        """Queue-routed subscription: `decode` runs inline on the reader
+        thread (raise = reject + downscore); the decoded item is submitted
+        on `work_type`'s lane and `process` (or `process_batch` for the
+        coalescing kinds) runs on a worker, classifying its outcome by
+        exception: clean return = Accept (relay + credit), GossipIgnore =
+        Ignore, ValueError (the chain's validation family) = Reject
+        (downscore), anything else = internal error (counted, never the
+        peer's fault)."""
+        self._queued[topic] = _QueuedTopic(
+            work_type=work_type,
+            decode=decode,
+            process=process,
+            process_batch=process_batch,
+        )
         self.behaviour.subscribe(topic)
 
     def publish(self, topic: str, data: bytes):
@@ -267,11 +384,32 @@ class GossipRouter:
             return
         self.behaviour.handle_frame(peer_id, frame)
 
-    def _deliver(self, topic: str, data: bytes, origin: str) -> bool:
+    def _deliver(self, topic: str, data: bytes, origin: str):
         """Validate-then-forward (gossipsub accept/reject semantics): a
         message our handler rejects is NOT relayed, so invalid data never
         costs downstream peers score — and the rejection feeds both the
-        gossipsub score (graylisting) and the PeerManager (banning)."""
+        gossipsub score (graylisting) and the PeerManager (banning).
+
+        Queue-routed topics decode here (thin, reader-thread) and defer
+        the chain-touching validation to the beacon_processor: the relay
+        decision returns DEFERRED and lands later via the queued
+        handler's outcome. A full lane sheds the message (drop-counted by
+        `submit`) — neither relayed nor penalized, never a stalled
+        socket."""
+        q = self._queued.get(topic)
+        if q is not None:
+            try:
+                item = q.decode(data)
+            except Exception:  # noqa: BLE001 — undecodable gossip: reject
+                self.service.peers.report(origin, SCORE_INVALID_MESSAGE)
+                inc_counter("gossip_invalid_total")
+                return False
+            self.service.processor.submit(
+                q.work_type,
+                _GossipWork(topic=topic, item=item, data=data, origin=origin),
+                self._runner_for(q.work_type),
+            )
+            return DEFERRED
         handler = self._handlers.get(topic)
         if handler is None:
             return True  # relay-only topic: forwardable, nothing local
@@ -283,6 +421,124 @@ class GossipRouter:
             return False
         self.service.peers.report(origin, SCORE_TIMELY_MESSAGE)
         return True
+
+    # -- queued validation (worker side) ---------------------------------
+
+    def _runner_for(self, work_type: WorkType):
+        runner = self._runners.get(work_type)
+        if runner is None:
+            runner = (
+                self._run_queued_batch
+                if work_type in BATCHED_WORK_TYPES
+                else self._run_queued_single
+            )
+            self._runners[work_type] = runner
+        return runner
+
+    def _run_queued_single(self, work: _GossipWork):
+        entry = self._queued[work.topic]
+        self._complete(work, self._classify(entry.process, work.item))
+
+    def _run_queued_batch(self, works: list):
+        """One drained batch of a coalescing kind: group by registration
+        (all attestation subnets share one) and hand `process_batch` the
+        whole item list — that is what turns a storm of per-message
+        verifications into one RLC signature batch."""
+        groups: dict[int, tuple[_QueuedTopic, list]] = {}
+        for w in works:
+            entry = self._queued[w.topic]
+            fn = entry.process_batch or entry.process
+            # group by the UNDERLYING function: distinct bound-method
+            # objects wrapping the same method must coalesce
+            key = id(getattr(fn, "__func__", fn))
+            groups.setdefault(key, (entry, []))[1].append(w)
+        for entry, ws in groups.values():
+            if entry.process_batch is None:
+                for w in ws:
+                    self._complete(w, self._classify(entry.process, w.item))
+                continue
+            try:
+                outcomes = entry.process_batch([w.item for w in ws])
+                if len(outcomes) != len(ws):
+                    # a short/long outcome list would leave tail messages
+                    # with NO relay/score decision — the silent-drop class
+                    # this pipeline is built to eliminate
+                    raise RuntimeError(
+                        f"process_batch returned {len(outcomes)} outcomes "
+                        f"for {len(ws)} items"
+                    )
+            except Exception as e:  # noqa: BLE001 — whole-batch fault
+                outcomes = [e] * len(ws)
+            for w, err in zip(ws, outcomes):
+                self._complete(w, err)
+
+    @staticmethod
+    def _classify(process, item):
+        """Run one process step, returning its outcome exception (None =
+        Accept). Workers never see these raise — classification is the
+        router's, not the processor's error counter's."""
+        try:
+            process(item)
+            return None
+        except Exception as e:  # noqa: BLE001 — classified by _complete
+            return e
+
+    def _complete(self, work: _GossipWork, err):
+        """Deferred relay/score decision (reference Accept/Ignore/Reject):
+        clean = relay + credit; Ignore = drop quietly; Reject (a chain
+        ValueError) = penalize origin, never relay; anything else
+        is an INTERNAL error — our store/bug, not the peer's message — so
+        it is logged and counted but costs the origin nothing."""
+        if err is None:
+            self.service.peers.report(work.origin, SCORE_TIMELY_MESSAGE)
+            try:
+                self._relay_q.put_nowait((work.topic, work.data, work.origin))
+            except queue.Full:
+                inc_counter("gossip_relay_dropped_total")
+        elif isinstance(err, GossipIgnore):
+            inc_counter("gossip_ignored_total")
+        elif isinstance(err, ValueError):
+            self.behaviour.complete_validation(
+                work.topic, work.data, work.origin, False
+            )
+            self.service.peers.report(work.origin, SCORE_INVALID_MESSAGE)
+            inc_counter("gossip_invalid_total")
+        else:
+            inc_counter("gossip_internal_error_total")
+            log.warning(
+                "gossip handler internal error",
+                topic=_short_topic(work.topic),
+                error=f"{type(err).__name__}: {str(err)[:200]}",
+            )
+
+    def _relay_loop(self):
+        """Deferred-Accept completions: mcache entry, P2 credit, and the
+        eager mesh forward (`behaviour.complete_validation`) — serialized
+        off the worker pool so socket stalls cost relay latency, not
+        validation throughput."""
+        while not self._relay_stop.is_set():
+            try:
+                item = self._relay_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if item is None:
+                return
+            topic, data, origin = item
+            try:
+                self.behaviour.complete_validation(topic, data, origin, True)
+            except Exception as e:  # noqa: BLE001 — relay must outlive faults
+                log.warning("gossip relay failed", error=str(e)[:200])
+
+    def stop(self):
+        """Stop the relay thread (joined — NetworkService.stop's
+        zero-thread-leak audit covers it). Event-first: a full queue or a
+        relay mid-send must not block the caller."""
+        self._relay_stop.set()
+        try:
+            self._relay_q.put_nowait(None)
+        except queue.Full:
+            pass
+        self._relay_thread.join(timeout=2)
 
     def _send_frame(self, peer_id: str, payload: bytes):
         peer = self.service.peers.get(peer_id)
@@ -343,7 +599,7 @@ class GossipRouter:
 
 # the sync engine lives in its own package (network/sync/); imported here
 # AFTER the score constants it references at call time
-from .sync import SyncConfig, SyncManager  # noqa: E402
+from .sync import SyncConfig, SyncManager, SyncService  # noqa: E402
 
 
 class NetworkService:
@@ -369,6 +625,7 @@ class NetworkService:
         gossip_config=None,
         sync_config=None,
         processor_workers: int = 2,
+        sync_service_interval: float | None = None,
     ):
         self.chain = chain
         self.spec = chain.spec
@@ -386,12 +643,26 @@ class NetworkService:
         )
         self.reprocess = ReprocessQueue()
         self.sync = SyncManager(self, config=sync_config)
+        # autonomous catch-up (sync/manager.rs main-loop role): started in
+        # start() when an interval is configured — the node path enables
+        # it so range sync no longer waits for a caller. 0/None disables,
+        # same convention as heartbeat_interval (a 0-second poll would be
+        # a busy loop, not "continuous")
+        self.sync_service = (
+            SyncService(self.sync, interval=sync_service_interval)
+            if sync_service_interval
+            else None
+        )
         self.metadata_seq = 1
         self.server = RpcServer(self, host, port)
         self.port = self.server.port
         self.heartbeat_interval = heartbeat_interval
         self._hb_thread = None
         self._stopping = False
+        self._stop_event = threading.Event()
+        #: last slot the heartbeat tick saw: reprocess slot drains/expiry
+        #: fire once per slot edge
+        self._last_tick_slot = -1
         # discv5 analog: advertise our record, bootstrap from bootnodes
         # (None → discovery disabled, as with the reference's --disable-discovery)
         self.discovery = None
@@ -453,22 +724,61 @@ class NetworkService:
             thresholds=gossip_thresholds,
             config=gossip_config,
         )
-        self.gossip.subscribe(self.topic_block, self._on_gossip_block)
+        # every gossip kind is queue-routed: thin decode on the reader
+        # thread, chain work on its own prioritized WorkType lane
+        # (network_beacon_processor/gossip_methods.rs shape)
+        self.gossip.subscribe_queued(
+            self.topic_block,
+            WorkType.GOSSIP_BLOCK,
+            self._decode_gossip_block,
+            self._process_gossip_block,
+        )
+        # NOTE: each subnet registration mints a fresh bound-method
+        # object; the batch runner groups by the UNDERLYING function
+        # (`__func__`), so all 64 subnets still coalesce into one
+        # process_attestation_batch call per drained batch
         for topic in self.attestation_topics.values():
-            self.gossip.subscribe(topic, self._on_gossip_attestation)
-        self.gossip.subscribe(self.topic_aggregate, self._on_gossip_aggregate)
-        self.gossip.subscribe(self.topic_exit, self._on_gossip_exit)
-        self.gossip.subscribe(
-            self.topic_proposer_slashing, self._on_gossip_proposer_slashing
+            self.gossip.subscribe_queued(
+                topic,
+                WorkType.GOSSIP_ATTESTATION,
+                self._decode_gossip_attestation,
+                process_batch=self._process_gossip_attestation_batch,
+            )
+        self.gossip.subscribe_queued(
+            self.topic_aggregate,
+            WorkType.GOSSIP_AGGREGATE,
+            self._decode_gossip_aggregate,
+            self._process_gossip_aggregate,
         )
-        self.gossip.subscribe(
-            self.topic_attester_slashing, self._on_gossip_attester_slashing
+        self.gossip.subscribe_queued(
+            self.topic_exit,
+            WorkType.GOSSIP_VOLUNTARY_EXIT,
+            self._decode_gossip_exit,
+            self._process_gossip_exit,
         )
-        self.gossip.subscribe(
-            self.topic_sync_committee, self._on_gossip_sync_committee
+        self.gossip.subscribe_queued(
+            self.topic_proposer_slashing,
+            WorkType.GOSSIP_PROPOSER_SLASHING,
+            self._decode_gossip_proposer_slashing,
+            self._process_gossip_proposer_slashing,
         )
-        self.gossip.subscribe(
-            self.topic_blob_sidecar, self._on_gossip_blob_sidecar
+        self.gossip.subscribe_queued(
+            self.topic_attester_slashing,
+            WorkType.GOSSIP_ATTESTER_SLASHING,
+            self._decode_gossip_attester_slashing,
+            self._process_gossip_attester_slashing,
+        )
+        self.gossip.subscribe_queued(
+            self.topic_sync_committee,
+            WorkType.GOSSIP_SYNC_COMMITTEE,
+            self._decode_gossip_sync_committee,
+            self._process_gossip_sync_committee,
+        )
+        self.gossip.subscribe_queued(
+            self.topic_blob_sidecar,
+            WorkType.GOSSIP_BLOB_SIDECAR,
+            self._decode_gossip_blob_sidecar,
+            self._process_gossip_blob_sidecar,
         )
 
     # -- lifecycle -------------------------------------------------------------
@@ -484,17 +794,35 @@ class NetworkService:
                 name=f"gossip-heartbeat-{self.port}",
             )
             self._hb_thread.start()
+        if self.sync_service is not None:
+            self.sync_service.start()
         return self
 
     def _heartbeat_loop(self):
         while not self._stopping:
-            time.sleep(self.heartbeat_interval)
+            self._stop_event.wait(self.heartbeat_interval)
             if self._stopping:
                 break
             try:
                 self.gossip.heartbeat()
             except Exception as e:  # noqa: BLE001 — heartbeat must outlive faults
                 log.warning("gossip heartbeat failed", error=str(e)[:200])
+            try:
+                self.slot_tick()
+            except Exception as e:  # noqa: BLE001 — ditto
+                log.warning("slot tick failed", error=str(e)[:200])
+
+    def slot_tick(self):
+        """Once per slot edge (heartbeat-driven; tests call directly):
+        re-fire reprocess work held for the new slot and expire held
+        unknown-block work whose block never came — the bound that stops
+        the ReprocessQueue leaking under storm. Idempotent within a slot."""
+        slot = int(self.chain.slot_clock.now())
+        if slot == self._last_tick_slot:
+            return
+        self._last_tick_slot = slot
+        self.reprocess.slot_started(slot, self.processor)
+        self.reprocess.expire(slot)
 
     def discover_and_connect(self, max_peers: int = 8) -> int:
         """One discovery round → dial every new connectable record
@@ -520,8 +848,24 @@ class NetworkService:
         return connected
 
     def stop(self):
+        """Graceful teardown, audited for thread leaks: the sync-service
+        loop, the heartbeat/slot-tick thread, the RPC server, and the
+        processor's manager+workers are all JOINED; queued processor work
+        is abandoned with a counter, and held reprocess work is cleared
+        the same way — nothing dropped silently, nothing left running."""
         self._stopping = True
+        self._stop_event.set()
+        if self.sync_service is not None:
+            self.sync_service.stop()
         self.sync.stop()
+        # the heartbeat/slot-tick thread joins BEFORE the processor shuts
+        # down: an in-flight slot_tick re-submits drained reprocess work,
+        # which must not land in a dead processor's queues (it would sit
+        # there uncounted — the silent drop this audit exists to prevent)
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+            self._hb_thread = None
+        self.gossip.stop()
         if self.discovery is not None:
             self.discovery.stop()
         for p in self.peers.peers():
@@ -532,6 +876,7 @@ class NetworkService:
             self._drop_peer(p)
         self.server.stop()
         self.processor.shutdown()
+        self.reprocess.clear()
 
     # -- identity / status ------------------------------------------------------
 
@@ -678,15 +1023,17 @@ class NetworkService:
         except ValueError as e:
             raise RpcError(str(e)) from e
 
-    def _on_gossip_block(self, data: bytes):
+    # decode steps run INLINE on the socket reader (cheap SSZ work only;
+    # raising rejects + downscores); process steps run on beacon_processor
+    # workers and classify via GossipIgnore / ValueError / internal error.
+
+    def _decode_gossip_block(self, data: bytes):
         import time as _time
 
         signed = self.decode_block(data)
-        from ..beacon_chain.chain import BlobsUnavailableError, BlockError
-
         # observation milestone at the earliest point we can name the
-        # block: even if the import below detours through a parent lookup,
-        # the eventual BlockTimes keeps the true gossip arrival time.
+        # block: even if the import detours through the queue or a parent
+        # lookup, the eventual BlockTimes keeps the true gossip arrival.
         # Clock-clamped: a hostile far-future slot must not enter the
         # cache (it would never be min-slot-evicted nor finality-pruned)
         slot = int(signed.message.slot)
@@ -694,12 +1041,17 @@ class NetworkService:
             self.chain.block_times_cache.set_observed(
                 signed.message.hash_tree_root(), slot, _time.monotonic()
             )
+        return signed
+
+    def _process_gossip_block(self, signed):
+        from ..beacon_chain.chain import BlobsUnavailableError, BlockError
+
         try:
             root = self.chain.process_block(signed)
         except BlobsUnavailableError:
             # expected ordering race, not peer fault: the block is staged
-            # in the DA checker; the completing sidecar's handler imports
-            # it (no downscore for the forwarder)
+            # in the DA checker and plausibly valid — relay it (the
+            # completing sidecar's handler imports it here later)
             log.info("block waiting on sidecars", slot=signed.message.slot)
             return
         except BlockError as e:
@@ -712,8 +1064,8 @@ class NetworkService:
                     slot=signed.message.slot,
                 )
                 self.sync.on_unknown_parent_block(signed)
-                return
-            raise
+                raise GossipIgnore("unknown parent") from e
+            raise  # BlockError(ValueError): genuine invalidity → reject
         # release work parked under this root (attestations that arrived
         # before the block, the usual out-of-order gossip case) — without
         # this, only lookup-recovered blocks would ever drain the queue
@@ -724,27 +1076,71 @@ class NetworkService:
             root=root.hex()[:12],
         )
 
-    def _on_gossip_attestation(self, data: bytes):
-        t = self.chain.types
-        att = t.Attestation.deserialize(data)
-        results = self.chain.process_attestation_batch([att])
-        if results and isinstance(results[0], Exception):
-            err = results[0]
-            if "unknown beacon block root" in str(err):
-                # hold the attestation until its block lands (the
-                # work_reprocessing_queue path) and go find the block
-                root = bytes(att.data.beacon_block_root)
-                self.reprocess.hold_for_block(
-                    root,
-                    WorkEvent(
-                        WorkType.UNKNOWN_BLOCK_ATTESTATION,
-                        att,
-                        self._reprocess_attestation,
-                    ),
-                )
-                self.sync.on_unknown_block_root(root)
-                return
-            raise err
+    def _decode_gossip_attestation(self, data: bytes):
+        return self.chain.types.Attestation.deserialize(data)
+
+    def _process_gossip_attestation_batch(self, atts: list) -> list:
+        """A whole drained GOSSIP_ATTESTATION batch in ONE RLC signature
+        verification — the coalescing that makes the attestation lane
+        survive a flood. Returns one outcome per item (None = accept)."""
+        results = self.chain.process_attestation_batch(atts)
+        out = []
+        for att, res in zip(atts, results):
+            if not isinstance(res, Exception):
+                out.append(None)
+            elif "unknown beacon block root" in str(res):
+                out.append(self._park_unknown_root_attestation(att))
+            elif "outside propagation window" in str(res):
+                out.append(self._park_early_attestation(att, res))
+            else:
+                out.append(res)
+        return out
+
+    #: clock-disparity tolerance for EARLY gossip (the reference's
+    #: MAXIMUM_GOSSIP_CLOCK_DISPARITY role, in slots): work this far
+    #: ahead parks until its slot starts; further is a hostile timestamp
+    EARLY_ATTESTATION_SLOT_TOLERANCE = 2
+
+    def _park_early_attestation(self, att, err):
+        """Propagation-window violations are IGNORE, never Reject (the
+        gossip spec's ATTESTATION_PROPAGATION_SLOT_RANGE semantics —
+        lateness is congestion, not malice, and penalizing it graylists
+        honest mesh peers exactly when the network is struggling). The
+        near-future case (peer clock slightly ahead) additionally parks
+        until its slot starts — the slot tick re-fires it through
+        `_reprocess_attestation`. Hostile far-future timestamps are
+        ignored WITHOUT parking (they must not occupy the queue)."""
+        slot = int(att.data.slot)
+        now = int(self.chain.slot_clock.now())
+        if now < slot <= now + self.EARLY_ATTESTATION_SLOT_TOLERANCE:
+            self.reprocess.hold_for_slot(
+                slot,
+                WorkEvent(
+                    WorkType.UNKNOWN_BLOCK_ATTESTATION,
+                    att,
+                    self._reprocess_attestation,
+                ),
+            )
+            return GossipIgnore("early attestation held for its slot")
+        return GossipIgnore(str(err))
+
+    def _park_unknown_root_attestation(self, att):
+        """Hold the attestation until its block lands (the
+        work_reprocessing_queue path, now capped + slot-stamped) and go
+        find the block; a cap refusal is load shed, still an Ignore."""
+        root = bytes(att.data.beacon_block_root)
+        held = self.reprocess.hold_for_block(
+            root,
+            WorkEvent(
+                WorkType.UNKNOWN_BLOCK_ATTESTATION,
+                att,
+                self._reprocess_attestation,
+            ),
+            slot=int(att.data.slot),
+        )
+        if held:
+            self.sync.on_unknown_block_root(root)
+        return GossipIgnore("unknown beacon block root")
 
     def _reprocess_attestation(self, att):
         """Reprocess-queue re-fire: the unknown block imported, so the held
@@ -753,47 +1149,109 @@ class NetworkService:
         if results and isinstance(results[0], Exception):
             raise results[0]  # worker counts it in beacon_processor_errors
 
-    def _on_gossip_aggregate(self, data: bytes):
-        t = self.chain.types
-        agg = t.SignedAggregateAndProof.deserialize(data)
+    def _decode_gossip_aggregate(self, data: bytes):
+        return self.chain.types.SignedAggregateAndProof.deserialize(data)
+
+    def _process_gossip_aggregate(self, agg):
+        """Aggregates get the same unknown-root parking attestations have
+        had since PR 5 — an aggregate that beats its block by one hop used
+        to be an error charged to an innocent forwarder."""
+        from ..beacon_chain.attestation_verification import AttestationError
+
+        try:
+            self.chain.process_aggregate(agg)
+        except AttestationError as e:
+            if "outside propagation window" in str(e):
+                # window violations are IGNORE, same as attestations
+                raise GossipIgnore(str(e)) from e
+            if "unknown beacon block root" not in str(e):
+                raise
+            data = agg.message.aggregate.data
+            root = bytes(data.beacon_block_root)
+            held = self.reprocess.hold_for_block(
+                root,
+                WorkEvent(
+                    WorkType.UNKNOWN_BLOCK_AGGREGATE,
+                    agg,
+                    self._reprocess_aggregate,
+                ),
+                slot=int(data.slot),
+            )
+            if held:
+                self.sync.on_unknown_block_root(root)
+            raise GossipIgnore("unknown beacon block root") from e
+
+    def _reprocess_aggregate(self, agg):
         self.chain.process_aggregate(agg)
 
-    def _on_gossip_exit(self, data: bytes):
-        """Exits/slashings are spec-verified (signatures included) against
-        the head state before pooling — an unverifiable op would otherwise
-        be packed into our own proposal (gossip_methods.rs)."""
-        t = self.chain.types
-        exit_ = t.SignedVoluntaryExit.deserialize(data)
+    # exits/slashings are spec-verified (signatures included) against the
+    # head state before pooling — an unverifiable op would otherwise be
+    # packed into our own proposal (gossip_methods.rs); the process steps
+    # are thin late-binding wrappers over the chain methods (a ValueError
+    # from the spec check classifies as a reject).
+
+    def _decode_gossip_exit(self, data: bytes):
+        return self.chain.types.SignedVoluntaryExit.deserialize(data)
+
+    def _process_gossip_exit(self, exit_):
         self.chain.process_voluntary_exit(exit_)
 
-    def _on_gossip_proposer_slashing(self, data: bytes):
-        t = self.chain.types
-        slashing = t.ProposerSlashing.deserialize(data)
+    def _decode_gossip_proposer_slashing(self, data: bytes):
+        return self.chain.types.ProposerSlashing.deserialize(data)
+
+    def _process_gossip_proposer_slashing(self, slashing):
         self.chain.process_proposer_slashing(slashing)
 
-    def _on_gossip_attester_slashing(self, data: bytes):
-        t = self.chain.types
-        slashing = t.AttesterSlashing.deserialize(data)
+    def _decode_gossip_attester_slashing(self, data: bytes):
+        return self.chain.types.AttesterSlashing.deserialize(data)
+
+    def _process_gossip_attester_slashing(self, slashing):
         self.chain.process_attester_slashing(slashing)
 
-    def _on_gossip_sync_committee(self, data: bytes):
-        t = self.chain.types
-        msg = t.SyncCommitteeMessage.deserialize(data)
+    def _decode_gossip_sync_committee(self, data: bytes):
+        return self.chain.types.SyncCommitteeMessage.deserialize(data)
+
+    def _process_gossip_sync_committee(self, msg):
         self.chain.process_sync_committee_message(msg)
 
-    def _on_gossip_blob_sidecar(self, data: bytes):
+    def _decode_gossip_blob_sidecar(self, data: bytes):
+        return self.chain.types.BlobSidecar.deserialize(data)
+
+    def _process_gossip_blob_sidecar(self, sc):
         """KZG-verify and stage a gossiped sidecar; when this sidecar
         completes a staged block's set, import that block NOW — its own
         gossip arrived earlier, failed the DA gate, and is dedup'd by the
-        seen-cache, so nothing else will retry it."""
-        t = self.chain.types
-        sc = t.BlobSidecar.deserialize(data)
+        seen-cache, so nothing else will retry it. An unknown PARENT for
+        the completed block starts a lookup instead of downscoring the
+        sidecar's forwarder (it did nothing wrong)."""
+        from ..beacon_chain.chain import BlockError
+
         block_root = sc.signed_block_header.message.hash_tree_root()
         avail = self.chain.process_blob_sidecars(block_root, [sc])
         if avail.available and not self.chain.fork_choice.contains_block(
             block_root
         ):
-            self.chain.process_block(avail.block)
+            try:
+                self.chain.process_block(avail.block)
+            except BlockError as e:
+                if "parent unknown" in str(e):
+                    log.info(
+                        "completed block has unknown parent; starting lookup",
+                        root=block_root.hex()[:12],
+                    )
+                    self.sync.on_unknown_parent_block(avail.block)
+                    raise GossipIgnore("unknown parent") from e
+                # the completed BLOCK failed import — the sidecar's
+                # forwarder could not have known (the sidecar itself
+                # KZG/header-verified): Ignore, never a penalty. The
+                # block's own gossip path penalizes whoever forwarded
+                # the invalid block.
+                log.info(
+                    "completed block failed import",
+                    root=block_root.hex()[:12],
+                    error=str(e)[:120],
+                )
+                raise GossipIgnore(str(e)) from e
             self.reprocess.block_imported(block_root, self.processor)
 
     # -- publishing -------------------------------------------------------------

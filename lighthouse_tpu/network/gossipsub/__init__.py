@@ -9,7 +9,12 @@ GossipRouter owns one GossipsubBehaviour and bridges it to sockets,
 handlers, and the PeerManager.
 """
 
-from .behaviour import GossipsubBehaviour, GossipsubConfig
+from .behaviour import (
+    DEFERRED,
+    GossipsubBehaviour,
+    GossipsubConfig,
+    _short_topic as short_topic,
+)
 from .frames import (
     FrameError,
     GraftFrame,
@@ -32,6 +37,7 @@ from .score import (
 )
 
 __all__ = [
+    "DEFERRED",
     "FrameError",
     "GossipsubBehaviour",
     "GossipsubConfig",
@@ -51,4 +57,5 @@ __all__ = [
     "beacon_score_thresholds",
     "decode_frame",
     "encode_frame",
+    "short_topic",
 ]

@@ -12,8 +12,10 @@ the PX threshold. Opportunistic grafting (behaviour.rs heartbeat tail)
 re-seeds a mesh whose median score has sagged.
 
 Transport-agnostic: the owner supplies `send(peer_id, frame_bytes)`,
-`deliver(topic, data, origin) -> bool` (app validation; False = invalid),
-and a message-id function. All outgoing frames are computed under the
+`deliver(topic, data, origin) -> bool | DEFERRED` (app validation; False =
+invalid; the `DEFERRED` sentinel means the owner queued validation and
+will report the outcome later via `complete_validation` — nothing is
+forwarded, scored, or cached until then), and a message-id function. All outgoing frames are computed under the
 state lock but SENT after it is released (socket sends serialize on
 per-peer locks upstream; holding the mesh lock across them would wedge
 every reader thread on one stalled peer). The heartbeat is caller-driven:
@@ -99,6 +101,13 @@ class GossipsubConfig:
 def _short_topic(topic: str) -> str:
     parts = topic.split("/")
     return parts[-2] if len(parts) >= 2 else topic
+
+
+#: returned by a `deliver` callback that queued validation instead of
+#: running it inline (the event-driven gossip path): the behaviour parks
+#: the message — no forward, no score, no mcache — until the owner calls
+#: `complete_validation` with the real outcome
+DEFERRED = object()
 
 
 class GossipsubBehaviour:
@@ -349,6 +358,32 @@ class GossipsubBehaviour:
         # validation runs OUTSIDE the lock: chain import is slow and must
         # not serialize the whole mesh behind one message
         valid = self._deliver(topic, data, peer_id)
+        if valid is DEFERRED:
+            # validation queued (beacon_processor lane): the relay and
+            # score decisions wait for complete_validation — the reader
+            # thread returns to its socket immediately
+            return
+        self._finish_validation(topic, data, peer_id, mid, bool(valid))
+
+    def complete_validation(
+        self, topic: str, data: bytes, origin: str, valid: bool
+    ):
+        """Deferred-validation outcome for a message whose `deliver`
+        returned DEFERRED: applies exactly the post-validation steps the
+        inline path would have — invalid → P4 penalty; valid → mcache,
+        P2 credit, eager forward to the mesh (minus the origin). Safe if
+        the origin disconnected meanwhile (score ops no-op)."""
+        self._finish_validation(topic, data, origin, None, valid)
+
+    def _finish_validation(
+        self, topic: str, data: bytes, peer_id: str, mid: bytes | None,
+        valid: bool,
+    ):
+        if valid and mid is None:
+            # deferred path: the receive-time mid wasn't carried through
+            # the queue hop; recompute only on Accept (the reject path
+            # never needs it) and outside the mesh lock
+            mid = self._mid(data)
         with self._lock:
             if not valid:
                 self.score.invalid_message(peer_id, topic)

@@ -20,6 +20,10 @@ router, range_sync/ + backfill_sync/ + block_lookups/ as the engines):
     reprocess-queue release of held attestations on import.
   * `network_context` — request ids, per-peer in-flight accounting, and
     blob-sidecar coupling shared by all three.
+  * `service` — the autonomous Status-listening loop (sync/manager.rs
+    main-loop role): watches peer heads, starts/stops range-sync
+    catch-up by itself with capped backoff between failed runs — the
+    node path has no `sync_to_head` callers anymore.
 
 Everything is metered: the `sync_state` gauge, per-chain
 `sync_batch_{downloads,retries,failures}_total`, `sync_lookup_*`
@@ -38,6 +42,7 @@ from .batch import Batch, BatchState
 from .block_lookups import BlockLookups
 from .network_context import SyncNetworkContext
 from .range_sync import SyncingChain
+from .service import SyncService
 
 __all__ = [
     "Batch",
@@ -47,6 +52,7 @@ __all__ = [
     "SyncConfig",
     "SyncManager",
     "SyncNetworkContext",
+    "SyncService",
     "SyncingChain",
     "verify_backfill_signatures",
 ]
@@ -137,10 +143,14 @@ class SyncManager:
         peer.status = status
         return self._range_sync([peer], int(status.head_slot))
 
-    def sync_to_head(self, peers=None) -> int:
-        """Multi-peer range sync to the best head the peer set advertises.
-        Peers whose Status request fails (stale/dead) are dropped from the
-        candidate pool instead of wedging the run."""
+    def poll_sync_candidates(self, peers=None):
+        """One Status round-trip per peer → (candidates, serving, target):
+        every peer that answered (status refreshed in place), the subset
+        advertising a head PAST ours — only those serve catch-up batches;
+        a behind/at-head peer hands every range window an empty batch,
+        which "succeeds" with zero blocks and starves the real download —
+        and the best advertised head. Shared by `sync_to_head` and the
+        autonomous SyncService so candidate policy can't diverge."""
         candidates = []
         for p in peers if peers is not None else self.service.peers.peers():
             try:
@@ -149,9 +159,22 @@ class SyncManager:
                 continue
             candidates.append(p)
         if not candidates:
-            return 0
+            return [], [], 0
         target = max(int(p.status.head_slot) for p in candidates)
-        return self._range_sync(candidates, target)
+        head = int(self.service.chain.head_state.slot)
+        serving = [p for p in candidates if int(p.status.head_slot) > head]
+        return candidates, serving, target
+
+    def sync_to_head(self, peers=None) -> int:
+        """Multi-peer range sync to the best head the peer set advertises.
+        Peers whose Status request fails (stale/dead) are dropped from the
+        candidate pool instead of wedging the run. Test/bench entry point:
+        the NODE path never calls this — the autonomous SyncService polls
+        Statuses and drives `_range_sync` itself."""
+        candidates, serving, target = self.poll_sync_candidates(peers)
+        if not candidates:
+            return 0
+        return self._range_sync(serving, target)
 
     def _range_sync(self, peers, target_slot: int) -> int:
         chain = self.service.chain

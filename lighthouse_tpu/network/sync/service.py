@@ -1,0 +1,156 @@
+"""SyncService: the autonomous Status-listening catch-up loop.
+
+The last caller-driven piece of the sync engine removed: until now range
+sync ran only when something invoked `sync_to_head` — a node that fell
+behind (restart, partition, a missed gossip block past the reprocess
+window) stayed behind until a caller noticed. This service plays the
+sync/manager.rs main-loop role: it watches the peer set's advertised
+heads (Status round-trips, the same handshake the engines already use),
+measures head lag against the wall clock, and starts/stops range-sync
+catch-up by itself —
+
+  * enters range sync when the best advertised (clock-clamped) head is
+    more than `head_lag_slots` ahead of ours;
+  * backs off exponentially (capped) after a run that made no progress,
+    so a stalled peer set is not hammered with Status+range storms;
+  * resets the backoff and re-enters immediately when a run progresses
+    or when we fall behind again later;
+  * shuts down cleanly: `stop()` wakes and JOINS the loop thread.
+
+The loop runs the same `_range_sync` batch state machine callers used to
+drive, so every retry/rotation/downscore behavior (and every
+`sync_batch_*` metric) is unchanged — only the trigger became
+autonomous. Runs are counted in `sync_service_runs_total{result=}` and
+the live backoff is exported as `sync_service_backoff_seconds`.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ...metrics import REGISTRY, inc_counter, set_gauge
+from ...utils.logging import get_logger
+
+log = get_logger("lighthouse_tpu.sync.service")
+
+# eager registration: dashboards and the gossip_soak bench read these
+# before the first run
+for _result in ("caught_up", "progress", "failed"):
+    REGISTRY.counter(
+        "sync_service_runs_total",
+        "autonomous range-sync runs, by outcome",
+    ).inc(0, result=_result)
+set_gauge("sync_service_backoff_seconds", 0)
+
+
+class SyncService:
+    def __init__(
+        self,
+        manager,
+        interval: float = 0.5,
+        head_lag_slots: int = 2,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 30.0,
+    ):
+        self.manager = manager
+        self.service = manager.service
+        self.interval = interval
+        #: tolerated head lag before catch-up starts: one slot of lag is
+        #: ordinary gossip latency, not a reason to open a range sync
+        self.head_lag_slots = head_lag_slots
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self._consecutive_failures = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        #: total catch-up runs attempted (tests read this)
+        self.runs = 0
+
+    # -- lifecycle --------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "SyncService":
+        if self.running:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop,
+            daemon=True,
+            name=f"sync-service-{self.service.port}",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                # still draining a range run (it observes service._stopping
+                # and the shut-down processor refuses its submits):
+                # `running` stays truthful so a restart can't spawn a
+                # second loop beside the orphan
+                return
+        self._thread = None
+
+    # -- the loop ---------------------------------------------------------
+
+    def backoff_s(self) -> float:
+        if self._consecutive_failures == 0:
+            return 0.0
+        return min(
+            self.backoff_max_s,
+            self.backoff_base_s * (2 ** (self._consecutive_failures - 1)),
+        )
+
+    def _loop(self):
+        while not self._stop.wait(self.interval + self.backoff_s()):
+            try:
+                self._tick()
+            except Exception as e:  # noqa: BLE001 — the loop must outlive faults
+                log.warning("sync service tick failed", error=str(e)[:200])
+                self._consecutive_failures += 1
+                inc_counter("sync_service_runs_total", result="failed")
+            set_gauge("sync_service_backoff_seconds", self.backoff_s())
+
+    def _tick(self):
+        chain = self.service.chain
+        # the shared candidate policy (SyncManager.poll_sync_candidates):
+        # dead/stale peers drop out; only peers advertising a head past
+        # ours serve catch-up batches (flooders at slot 0 would otherwise
+        # poison the rotation with empty windows — seen in the storm sim)
+        candidates, serving, target = self.manager.poll_sync_candidates()
+        if not candidates:
+            return
+        # a Status head_slot is attacker-controlled: clamp to the wall
+        # clock before it can size anything (same rule as _range_sync)
+        target = min(target, int(chain.slot_clock.now()))
+        head_before = int(chain.head_state.slot)
+        lag = target - head_before
+        if lag <= self.head_lag_slots:
+            self._consecutive_failures = 0
+            return
+        self.runs += 1
+        imported = self.manager._range_sync(serving, target)
+        caught_up = int(chain.head_state.slot) >= target
+        progressed = imported > 0 or int(chain.head_state.slot) > head_before
+        if caught_up:
+            self._consecutive_failures = 0
+            inc_counter("sync_service_runs_total", result="caught_up")
+        elif progressed:
+            self._consecutive_failures = 0
+            inc_counter("sync_service_runs_total", result="progress")
+        else:
+            self._consecutive_failures += 1
+            inc_counter("sync_service_runs_total", result="failed")
+        log.info(
+            "autonomous sync run",
+            target=target,
+            imported=imported,
+            caught_up=caught_up,
+            backoff_s=self.backoff_s(),
+        )

@@ -165,6 +165,26 @@ def pytest_sessionstart(session):
         "rpc_client_request_seconds_status",
         "rpc_client_request_seconds_beacon_blocks_by_range",
         "rpc_client_request_seconds_metadata",
+        # PR 11: event-driven node — gossip outcome counters, processor
+        # abandonment, the bounded reprocess queue, and the autonomous
+        # sync service must exist at zero (the gossip_soak bench and the
+        # storm sim read them eagerly)
+        "gossip_internal_error_total",
+        "gossip_ignored_total",
+        'beacon_processor_abandoned_total{kind="gossip_block"}',
+        'beacon_processor_abandoned_total{kind="gossip_attestation"}',
+        "reprocess_held_total",
+        "reprocess_drained_total",
+        'reprocess_expired_total{reason="slot"}',
+        'reprocess_expired_total{reason="root_cap"}',
+        'reprocess_expired_total{reason="total_cap"}',
+        'reprocess_expired_total{reason="shutdown"}',
+        "reprocess_queue_depth",
+        'sync_service_runs_total{result="caught_up"}',
+        'sync_service_runs_total{result="progress"}',
+        'sync_service_runs_total{result="failed"}',
+        "sync_service_backoff_seconds",
+        'beacon_processor_queue_depth_by_kind{kind="gossip_sync_committee"}',
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"

@@ -80,6 +80,105 @@ def test_reprocess_queue_block_and_slot():
     assert sorted(seen) == ["att1", "early"]
 
 
+def test_reprocess_queue_per_root_and_total_caps():
+    from lighthouse_tpu.metrics import REGISTRY
+
+    rq = ReprocessQueue(per_root_cap=2, total_cap=5)
+
+    def h(item):
+        pass
+
+    def ev(i):
+        return WorkEvent(WorkType.UNKNOWN_BLOCK_ATTESTATION, i, h)
+
+    root_cap_before = REGISTRY.counter("reprocess_expired_total").value(
+        reason="root_cap"
+    )
+    total_cap_before = REGISTRY.counter("reprocess_expired_total").value(
+        reason="total_cap"
+    )
+    root = b"\x01" * 32
+    assert rq.hold_for_block(root, ev(1), slot=10)
+    assert rq.hold_for_block(root, ev(2), slot=10)
+    # one hostile root cannot monopolize the queue
+    assert not rq.hold_for_block(root, ev(3), slot=10)
+    assert REGISTRY.counter("reprocess_expired_total").value(
+        reason="root_cap"
+    ) == root_cap_before + 1
+    # distinct roots fill to the total cap, then refuse
+    for j in range(3):
+        assert rq.hold_for_block(bytes([j + 2]) * 32, ev(j), slot=10)
+    assert len(rq) == 5
+    assert not rq.hold_for_block(b"\x09" * 32, ev(9), slot=10)
+    assert not rq.hold_for_slot(11, ev(10))
+    assert REGISTRY.counter("reprocess_expired_total").value(
+        reason="total_cap"
+    ) == total_cap_before + 2
+    assert len(rq) == 5
+
+
+def test_reprocess_queue_slot_expiry():
+    from lighthouse_tpu.metrics import REGISTRY
+
+    rq = ReprocessQueue(expiry_slots=2)
+
+    def h(item):
+        pass
+
+    rq.hold_for_block(
+        b"\x01" * 32, WorkEvent(WorkType.UNKNOWN_BLOCK_ATTESTATION, "a", h), slot=10
+    )
+    rq.hold_for_block(
+        b"\x02" * 32, WorkEvent(WorkType.UNKNOWN_BLOCK_AGGREGATE, "b", h), slot=12
+    )
+    # unstamped entries never slot-expire (caps still bound them)
+    rq.hold_for_block(
+        b"\x03" * 32, WorkEvent(WorkType.UNKNOWN_BLOCK_ATTESTATION, "c", h)
+    )
+    before = REGISTRY.counter("reprocess_expired_total").value(reason="slot")
+    assert rq.expire(12) == 0  # slot 10 + 2 not yet past
+    assert rq.expire(13) == 1  # slot-10 entry expires; slot-12 survives
+    assert rq.expire(15) == 1  # slot-12 entry expires; unstamped survives
+    assert REGISTRY.counter("reprocess_expired_total").value(
+        reason="slot"
+    ) == before + 2
+    assert len(rq) == 1
+    # expired work never re-fires
+    proc = BeaconProcessor(num_workers=1)
+    assert rq.block_imported(b"\x01" * 32, proc) == 0
+    assert rq.block_imported(b"\x03" * 32, proc) == 1
+    proc.drain()
+    proc.shutdown()
+
+
+def test_shutdown_abandons_queued_work_with_counter():
+    """Graceful-shutdown audit: work still queued when the processor stops
+    is explicitly abandoned and counted, never silently dropped (and
+    shutdown never blocks behind the backlog)."""
+    from lighthouse_tpu.metrics import REGISTRY
+
+    proc = BeaconProcessor(num_workers=1)
+
+    def h(item):
+        pass
+
+    abandoned = REGISTRY.counter("beacon_processor_abandoned_total")
+    before = abandoned.value(kind="api_request")
+    # push while HOLDING the cv so the manager cannot drain between the
+    # pushes and the shutdown flag — deterministic abandonment
+    with proc._cv:
+        for i in range(5):
+            assert proc._queues.push(
+                WorkEvent(WorkType.API_REQUEST, i, h)
+            )
+        proc._shutdown = True
+        proc._cv.notify_all()
+    proc._manager.join(timeout=2)
+    assert not proc._manager.is_alive()
+    assert abandoned.value(kind="api_request") == before + 5
+    proc.shutdown()  # idempotent full cleanup (workers join on sentinels)
+
+
 def test_slot_timer_manual_tick():
     from lighthouse_tpu.beacon_chain.timer import SlotTimer
     from lighthouse_tpu.utils.slot_clock import ManualSlotClock

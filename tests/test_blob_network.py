@@ -109,12 +109,19 @@ def test_sidecar_completion_triggers_block_import(kzg):
             available=True, block=signed, blobs=scs
         )
         h.chain.process_block = lambda blk: imported.append(blk)
-        na._on_gossip_blob_sidecar(sidecars[0].serialize())
+        # the queue-routed path: deliver → GOSSIP_BLOB_SIDECAR lane
+        na.gossip._deliver(
+            na.topic_blob_sidecar, sidecars[0].serialize(), "test-origin"
+        )
+        assert na.processor.drain()
         assert imported == [signed]
         # already-known blocks are not re-imported
         imported.clear()
         h.chain.fork_choice.contains_block = lambda root: True
-        na._on_gossip_blob_sidecar(sidecars[0].serialize())
+        na.gossip._deliver(
+            na.topic_blob_sidecar, sidecars[0].serialize(), "test-origin"
+        )
+        assert na.processor.drain()
         assert imported == []
     finally:
         na.stop()

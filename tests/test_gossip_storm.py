@@ -1,0 +1,519 @@
+"""Event-driven node under storm: queue-routed gossip + autonomous sync.
+
+The acceptance sims for the event-driven refactor, over real TCP sockets:
+
+* a sustained attestation flood from faulty peers runs CONCURRENTLY with
+  a range-sync catch-up driven by the autonomous SyncService — the sync
+  completes, the flood's excess is shed through counted drops (reprocess
+  caps, processor backpressure), and chain state transitions NEVER run on
+  a socket reader thread (asserted two ways: direct thread-name
+  instrumentation, and the stack profiler's thread-kind folding);
+* the Accept/Ignore/Reject split: internal handler faults cost the
+  forwarding peer nothing (`gossip_internal_error_total`), while genuine
+  validation rejects still downscore;
+* unknown-root aggregates park in the (bounded) reprocess queue like
+  attestations have since PR 5, and slot-tick expiry reclaims work whose
+  block never arrives;
+* graceful shutdown leaks no threads.
+"""
+
+import threading
+import time
+from dataclasses import replace
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.metrics import REGISTRY
+from lighthouse_tpu.metrics.profiler import StackProfiler
+from lighthouse_tpu.network import NetworkService, SyncConfig
+from lighthouse_tpu.network.sync import SyncService
+from lighthouse_tpu.testing.sync_faults import FaultPlan, FaultyNetworkService
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+
+def _harness(slots=0):
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    h = BeaconChainHarness(spec, E, validator_count=16)
+    if slots:
+        h.extend_chain(slots, attest=False)
+    return h
+
+
+def _fast_cfg(**overrides) -> SyncConfig:
+    kw = dict(backoff_base_s=0.01, backoff_max_s=0.05, chain_timeout_s=30.0)
+    kw.update(overrides)
+    return SyncConfig(**kw)
+
+
+def _counter(name, **labels):
+    return REGISTRY.counter(name).value(**labels)
+
+
+def _wait(predicate, timeout=30.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _stop_all(*services):
+    for s in services:
+        s.stop()
+
+
+# -- THE storm sim -------------------------------------------------------------
+
+
+def test_gossip_storm_sync_completes_and_load_is_shed():
+    """Two faulty peers flood unknown-root attestations at node B while
+    the autonomous sync service catches B up 4 epochs from the honest
+    peer. Asserts the tentpole contract end to end."""
+    a = _harness(slots=4 * E.SLOTS_PER_EPOCH)
+    b = _harness()
+    f1, f2 = _harness(), _harness()
+    na = NetworkService(a.chain).start()
+    nb = NetworkService(
+        b.chain,
+        sync_config=_fast_cfg(max_parallel_downloads=2),
+        sync_service_interval=0.1,
+        heartbeat_interval=0.05,
+    ).start()
+    nf1 = NetworkService(f1.chain, heartbeat_interval=None).start()
+    nf2 = NetworkService(f2.chain, heartbeat_interval=None).start()
+
+    # direct instrumentation: record the THREAD each state transition on
+    # B runs on — the tentpole claim is "never a gossip reader thread"
+    seen_threads: set[str] = set()
+    real_batch = b.chain.process_attestation_batch
+    real_block = b.chain.process_block
+
+    def rec_batch(atts):
+        seen_threads.add(threading.current_thread().name)
+        return real_batch(atts)
+
+    def rec_block(*args, **kw):
+        seen_threads.add(threading.current_thread().name)
+        return real_block(*args, **kw)
+
+    b.chain.process_attestation_batch = rec_batch
+    b.chain.process_block = rec_block
+
+    tip = a.chain.head_state.slot
+    # flood payload: decodable attestations for a bounded set of unknown
+    # roots — each parks (Ignore, no peer penalty) until the per-root cap
+    # bites, then the refusals ARE the counted load shedding
+    template = a.make_unaggregated_attestations(tip, a.chain.head_root)[0]
+    garbage_roots = [bytes([0x70 + j]) * 32 for j in range(4)]
+    t = a.chain.types
+
+    stop_flood = threading.Event()
+    published = [0, 0]
+
+    def flood(nf, lane):
+        i = 0
+        while not stop_flood.is_set():
+            att = template.copy()
+            att.data.beacon_block_root = garbage_roots[i % len(garbage_roots)]
+            # unique signature bytes → unique message-id (the flooder's
+            # own publish dedup must not collapse the flood)
+            att.signature = (lane * (1 << 32) + i).to_bytes(8, "little") + bytes(88)
+            nf.gossip.publish(nf.topic_att, t.Attestation.serialize_value(att))
+            published[lane] += 1
+            i += 1
+            time.sleep(0.002)  # sustained, not GIL-starving
+
+    prof = StackProfiler(hz=200)
+    prof.start()
+    floods = []
+    try:
+        # no gossip blocks flow in this sim, so the service must close the
+        # FULL lag itself — zero tolerance (see the re-entry test)
+        nb.sync_service.head_lag_slots = 0
+        b.slot_clock.set_slot(tip)
+        nb.connect("127.0.0.1", na.port)
+        nf1.connect("127.0.0.1", nb.port)
+        nf2.connect("127.0.0.1", nb.port)
+
+        held_before = _counter("reprocess_held_total")
+        shed_before = _counter("reprocess_expired_total", reason="root_cap")
+        floods = [
+            threading.Thread(target=flood, args=(nf, lane), daemon=True)
+            for lane, nf in enumerate((nf1, nf2))
+        ]
+        for th in floods:
+            th.start()
+
+        # NO sync_to_head call anywhere: the autonomous service sees the
+        # 4-epoch lag through na's Status and catches up under the flood
+        _wait(
+            lambda: b.chain.head_root == a.chain.head_root,
+            timeout=60,
+            what="autonomous catch-up under flood",
+        )
+        # keep the flood going a moment past catch-up so the caps bite
+        _wait(
+            lambda: _counter("reprocess_expired_total", reason="root_cap")
+            > shed_before,
+            timeout=30,
+            what="per-root cap shedding",
+        )
+    finally:
+        stop_flood.set()
+        for th in floods:
+            th.join(timeout=5)
+        prof.stop()
+    try:
+        assert nb.processor.drain(timeout=15)
+        assert sum(published) > 0
+        assert nb.sync_service.runs >= 1
+
+        # load shed, counted: attestations parked up to the caps, excess
+        # refused — never a hung socket
+        assert _counter("reprocess_held_total") > held_before
+        assert (
+            _counter("reprocess_expired_total", reason="root_cap") > shed_before
+        )
+        assert len(nb.reprocess) <= nb.reprocess.total_cap
+
+        # the flood was IGNORED work (unknown root): the honest peer and
+        # even the flooders keep their standing — nobody was downscored
+        # for our missing blocks
+        assert nb.peers.get(f"127.0.0.1:{na.port}") is not None
+
+        # tentpole: every state transition ran on a worker (or a sync
+        # thread) — never on a `gossip-<peer>` socket reader
+        assert seen_threads
+        readers = [n for n in seen_threads if n.startswith("gossip-")]
+        assert not readers, f"state transitions on reader threads: {readers}"
+
+        # the profiler's thread-kind folding agrees: no sampled chain
+        # frame sits under a gossip-reader thread kind
+        for line in prof.collapsed().splitlines():
+            if (
+                "process_attestation_batch (" in line
+                or "process_block (" in line
+                or "per_block_processing (" in line
+            ):
+                kind = next(
+                    (p for p in line.split(";") if p.startswith("thread:")), ""
+                )
+                assert not kind.startswith("thread:gossip-"), line
+
+        # queue observability saw the storm: the attestation lane both
+        # processed work and recorded queue waits
+        assert (
+            _counter("beacon_processor_processed_total", kind="gossip_attestation")
+            > 0
+        )
+
+        # slot-tick expiry reclaims what the flood left parked: advance
+        # the clock past the expiry window and tick
+        expired_before = _counter("reprocess_expired_total", reason="slot")
+        b.slot_clock.set_slot(tip + nb.reprocess.expiry_slots + 2)
+        nb.slot_tick()
+        assert _counter("reprocess_expired_total", reason="slot") > expired_before
+        assert len(nb.reprocess) == 0
+    finally:
+        _stop_all(na, nb, nf1, nf2)
+
+
+# -- Accept/Ignore/Reject split ------------------------------------------------
+
+
+def test_internal_error_is_counted_not_downscored_but_reject_is():
+    a = _harness(slots=2)
+    b = _harness()
+    na = NetworkService(a.chain).start()
+    nb = NetworkService(b.chain).start()
+    try:
+        b.slot_clock.set_slot(a.chain.head_state.slot)
+        nb.connect("127.0.0.1", na.port)
+        peer = nb.peers.get(f"127.0.0.1:{na.port}")
+        assert peer is not None
+        t = b.chain.types
+        exit_ = t.SignedVoluntaryExit(
+            message=t.VoluntaryExit(epoch=0, validator_index=3),
+            signature=b"\x0b" * 96,
+        )
+        data = exit_.serialize()
+
+        # internal fault (store error, bug): counted + logged, the
+        # forwarding peer keeps its score
+        def boom(_exit):
+            raise RuntimeError("store exploded")
+
+        b.chain.process_voluntary_exit = boom
+        before_internal = _counter("gossip_internal_error_total")
+        score_before = peer.score
+        nb.gossip._deliver(nb.topic_exit, data, peer.peer_id)
+        assert nb.processor.drain()
+        assert _counter("gossip_internal_error_total") == before_internal + 1
+        assert peer.score == score_before
+
+        # genuine validation reject (ValueError family): downscored
+        def reject(_exit):
+            raise ValueError("spec-invalid exit")
+
+        b.chain.process_voluntary_exit = reject
+        before_invalid = _counter("gossip_invalid_total")
+        nb.gossip._deliver(nb.topic_exit, data, peer.peer_id)
+        assert nb.processor.drain()
+        assert _counter("gossip_invalid_total") == before_invalid + 1
+        assert peer.score < score_before
+    finally:
+        _stop_all(na, nb)
+
+
+def test_unknown_root_aggregate_parks_and_expires():
+    """An aggregate for a root we don't have parks in the reprocess queue
+    (UNKNOWN_BLOCK_AGGREGATE lane) instead of erroring — and the slot
+    tick expires it when the block never arrives."""
+    a = _harness(slots=E.SLOTS_PER_EPOCH)
+    b = _harness()
+    na = NetworkService(a.chain).start()
+    nb = NetworkService(b.chain, heartbeat_interval=None).start()
+    try:
+        tip = a.chain.head_state.slot
+        b.slot_clock.set_slot(tip)
+        nb.connect("127.0.0.1", na.port)
+        t = a.chain.types
+        att = a.make_attestations(tip, a.chain.head_root)[0]
+        att = att.copy()
+        garbage = b"\x55" * 32
+        att.data.beacon_block_root = garbage
+        agg = t.SignedAggregateAndProof(
+            message=t.AggregateAndProof(
+                aggregator_index=0,
+                aggregate=att,
+                selection_proof=b"\x01" * 96,
+            ),
+            signature=b"\x02" * 96,
+        )
+        held_before = _counter("reprocess_held_total")
+        nb.gossip._deliver(nb.topic_aggregate, agg.serialize(), "test-origin")
+        assert nb.processor.drain()
+        assert _counter("reprocess_held_total") == held_before + 1
+        assert garbage in nb.reprocess._by_block_root
+
+        expired_before = _counter("reprocess_expired_total", reason="slot")
+        b.slot_clock.set_slot(tip + nb.reprocess.expiry_slots + 2)
+        nb.slot_tick()
+        assert (
+            _counter("reprocess_expired_total", reason="slot")
+            == expired_before + 1
+        )
+        assert not nb.reprocess._by_block_root
+    finally:
+        _stop_all(na, nb)
+
+
+def test_accepted_gossip_relays_through_the_relay_thread():
+    """Validate-then-forward survives queueing: A publishes a block to B
+    only; B's queued handler accepts it and the deferred relay (the
+    gossip-relay thread, NOT a worker or reader) forwards it to C."""
+    a = _harness(slots=2)
+    b = _harness()
+    c = _harness()
+    # heartbeats off on ALL nodes: no meshes ever form, so B's eager
+    # forward exercises the pre-mesh subscribed-peers fallback — with
+    # A's heartbeat on, A GRAFTs into B's mesh and B's mesh-only forward
+    # (minus the origin) correctly has nobody, which tests nothing
+    na = NetworkService(a.chain, heartbeat_interval=None).start()
+    nb = NetworkService(b.chain, heartbeat_interval=None).start()
+    nc = NetworkService(c.chain, heartbeat_interval=None).start()
+    try:
+        for h in (b, c):
+            h.slot_clock.set_slot(a.chain.head_state.slot)
+        peer_ab = nb.connect("127.0.0.1", na.port)
+        nb.sync.sync_with(peer_ab)
+        blocks = nb.blocks_by_range(1, b.chain.head_state.slot)
+        assert c.chain.process_chain_segment(blocks).error is None
+        nc.connect("127.0.0.1", nb.port)  # C talks ONLY to B
+        time.sleep(0.3)  # inbound registration + subscriptions settle
+
+        slot = a.chain.head_state.slot + 1
+        for h in (a, b, c):
+            h.slot_clock.set_slot(slot)
+        root, signed = a.add_block_at_slot(slot)
+        # A's service knows only B: the flood publish reaches B alone;
+        # C can only get the block if B's deferred Accept relays it
+        na.publish_block(signed)
+        _wait(lambda: b.chain.head_root == root, what="B imports via queue")
+        _wait(lambda: c.chain.head_root == root, what="C gets B's relay")
+    finally:
+        _stop_all(na, nb, nc)
+
+
+def test_early_attestation_parks_until_its_slot():
+    """A near-future attestation (peer clock slightly ahead) parks via
+    hold_for_slot instead of downscoring the forwarder; the slot tick
+    re-fires it when its slot starts and it lands in the op pool."""
+    a = _harness(slots=E.SLOTS_PER_EPOCH)
+    b = _harness()
+    na = NetworkService(a.chain).start()
+    nb = NetworkService(b.chain, heartbeat_interval=None).start()
+    try:
+        tip = a.chain.head_state.slot
+        b.slot_clock.set_slot(tip)
+        peer = nb.connect("127.0.0.1", na.port)
+        nb.sync.sync_with(peer)
+        assert b.chain.head_root == a.chain.head_root
+        t = b.chain.types
+        att = a.make_unaggregated_attestations(tip + 1, a.chain.head_root)[0]
+        before_pool = b.chain.op_pool.num_attestations()
+        score_before = peer.score
+        nb.gossip._deliver(
+            nb.topic_att, t.Attestation.serialize_value(att), peer.peer_id
+        )
+        assert nb.processor.drain()
+        assert b.chain.op_pool.num_attestations() == before_pool  # held
+        assert peer.score == score_before  # honestly-early: no penalty
+        assert len(nb.reprocess) == 1
+
+        b.slot_clock.set_slot(tip + 1)
+        nb.slot_tick()  # re-fires the held attestation on its slot
+        assert nb.processor.drain()
+        assert b.chain.op_pool.num_attestations() > before_pool
+        assert len(nb.reprocess) == 0
+
+        # a FAR-future slot (past the tolerance, clock now at tip+1) is
+        # IGNORED without parking: window violations are never rejects
+        # (spec semantics — lateness/clock skew is congestion, not
+        # malice), but a hostile timestamp must not occupy the queue
+        far = a.make_unaggregated_attestations(tip + 4, a.chain.head_root)[0]
+        ignored_before = _counter("gossip_ignored_total")
+        nb.gossip._deliver(
+            nb.topic_att, t.Attestation.serialize_value(far), peer.peer_id
+        )
+        assert nb.processor.drain()
+        assert _counter("gossip_ignored_total") == ignored_before + 1
+        assert peer.score == score_before  # no penalty for clock skew
+        assert len(nb.reprocess) == 0  # and nothing parked
+    finally:
+        _stop_all(na, nb)
+
+
+# -- autonomous sync service ---------------------------------------------------
+
+
+def test_sync_service_catches_up_and_reenters():
+    """No caller ever invokes sync_to_head: the service notices the lag,
+    catches up, goes idle, and re-enters when the node falls behind."""
+    a = _harness(slots=2 * E.SLOTS_PER_EPOCH)
+    b = _harness()
+    na = NetworkService(a.chain).start()
+    nb = NetworkService(
+        b.chain, sync_config=_fast_cfg(), sync_service_interval=0.05
+    ).start()
+    try:
+        # zero lag tolerance for the test: in production a ≤2-slot lag is
+        # left to gossip delivery, but this sim HAS no gossip — the
+        # service can race a concurrent extend_chain, catch up to a
+        # mid-extension target, and the residual lag would sit inside the
+        # default tolerance forever
+        nb.sync_service.head_lag_slots = 0
+        b.slot_clock.set_slot(a.chain.head_state.slot)
+        nb.connect("127.0.0.1", na.port)
+        _wait(
+            lambda: b.chain.head_root == a.chain.head_root,
+            what="first autonomous catch-up",
+        )
+        runs_first = nb.sync_service.runs
+        assert runs_first >= 1
+
+        # A advances another epoch that B never hears about via gossip;
+        # the service re-enters on the new lag
+        a.extend_chain(E.SLOTS_PER_EPOCH, attest=False)
+        b.slot_clock.set_slot(a.chain.head_state.slot)
+        _wait(
+            lambda: b.chain.head_root == a.chain.head_root,
+            what="re-entry after falling behind",
+        )
+        assert nb.sync_service.runs > runs_first
+    finally:
+        _stop_all(na, nb)
+
+
+def test_sync_service_backs_off_after_failed_runs():
+    """A peer that advertises a head it cannot serve: the first run makes
+    real progress, subsequent runs import nothing — consecutive failures
+    grow a capped exponential backoff instead of hammering the peer."""
+    a = _harness(slots=E.SLOTS_PER_EPOCH)
+    b = _harness()
+    liar = FaultyNetworkService(
+        a.chain, FaultPlan(stale_status_extra=E.SLOTS_PER_EPOCH)
+    ).start()
+    nb = NetworkService(b.chain, sync_config=_fast_cfg()).start()
+    svc = SyncService(
+        nb.sync, interval=0.05, backoff_base_s=0.05, backoff_max_s=0.2
+    )
+    try:
+        b.slot_clock.set_slot(2 * E.SLOTS_PER_EPOCH)
+        nb.connect("127.0.0.1", liar.port)
+        failed_before = _counter("sync_service_runs_total", result="failed")
+        svc.start()
+        _wait(
+            lambda: b.chain.head_root == a.chain.head_root,
+            what="real blocks imported",
+        )
+        _wait(
+            lambda: _counter("sync_service_runs_total", result="failed")
+            >= failed_before + 2,
+            what="repeated failed runs",
+        )
+        assert svc.backoff_s() > 0
+        assert svc.backoff_s() <= svc.backoff_max_s
+    finally:
+        svc.stop()
+        assert not svc.running
+        _stop_all(liar, nb)
+
+
+# -- graceful shutdown ---------------------------------------------------------
+
+
+def test_stop_leaks_no_threads():
+    """NetworkService with every loop armed (heartbeat/slot tick, sync
+    service, processor workers, RPC server) stops without leaking a
+    single live thread."""
+    a = _harness(slots=2)
+    before = set(threading.enumerate())
+    n = NetworkService(
+        a.chain, sync_service_interval=0.05, heartbeat_interval=0.02
+    ).start()
+    time.sleep(0.3)  # let every loop run at least once
+    n.stop()
+    _wait(
+        lambda: not [
+            th
+            for th in threading.enumerate()
+            if th not in before and th.is_alive()
+        ],
+        timeout=10,
+        what="all service threads to exit",
+    )
+
+
+def test_stop_abandons_queued_work_with_counter():
+    """NetworkService.stop on a node with parked + queued work: the
+    processor abandons its backlog and the reprocess queue clears, both
+    through counters — nothing silent, nothing hung."""
+    a = _harness(slots=2)
+    n = NetworkService(a.chain, heartbeat_interval=None).start()
+    from lighthouse_tpu.beacon_processor import WorkEvent, WorkType
+
+    n.reprocess.hold_for_block(
+        b"\x99" * 32,
+        WorkEvent(WorkType.UNKNOWN_BLOCK_ATTESTATION, "att", lambda _: None),
+        slot=1,
+    )
+    shutdown_before = _counter("reprocess_expired_total", reason="shutdown")
+    n.stop()
+    assert _counter("reprocess_expired_total", reason="shutdown") == (
+        shutdown_before + 1
+    )
+    assert len(n.reprocess) == 0

@@ -354,6 +354,95 @@ def test_metric_hygiene_suppressible_like_any_rule():
 
 
 # ---------------------------------------------------------------------------
+# queue-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_queue_discipline_fires_on_inline_chain_processing():
+    bad = (
+        "class Svc:\n"
+        "    def __init__(self):\n"
+        "        self.gossip.subscribe(self.topic_block, self._on_block)\n"
+        "    def _on_block(self, data):\n"
+        "        signed = self.decode_block(data)\n"
+        "        self.chain.process_block(signed)\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["queue-discipline"]
+
+
+def test_queue_discipline_fires_through_one_callee_hop():
+    bad = (
+        "class Svc:\n"
+        "    def __init__(self):\n"
+        "        self.gossip.subscribe(self.topic_att, self._on_att)\n"
+        "    def _on_att(self, data):\n"
+        "        self._apply(data)\n"
+        "    def _apply(self, data):\n"
+        "        self.chain.process_attestation_batch([data])\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["queue-discipline"]
+
+
+def test_queue_discipline_fires_on_chain_touching_decode_step():
+    bad = (
+        "class Svc:\n"
+        "    def __init__(self, wt):\n"
+        "        self.gossip.subscribe_queued(\n"
+        "            self.topic_block, wt, self._decode, self._process\n"
+        "        )\n"
+        "    def _decode(self, data):\n"
+        "        return self.chain.process_block(data)\n"
+        "    def _process(self, item):\n"
+        "        pass\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["queue-discipline"]
+
+
+def test_queue_discipline_follows_local_aliases():
+    """Registering through a local alias (`decode = self._decode`) must
+    not dodge the scan — review found the package's own attestation
+    decode briefly registered exactly this way."""
+    bad = (
+        "class Svc:\n"
+        "    def __init__(self, wt):\n"
+        "        decode = self._decode\n"
+        "        for topic in self.topics:\n"
+        "            self.gossip.subscribe_queued(topic, wt, decode)\n"
+        "    def _decode(self, data):\n"
+        "        return self.chain.process_attestation_batch([data])\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["queue-discipline"]
+
+
+def test_queue_discipline_clean_when_routed_through_submit():
+    good = (
+        "class Svc:\n"
+        "    def __init__(self, wt):\n"
+        "        self.gossip.subscribe_queued(\n"
+        "            self.topic_block, wt, self._decode, self._process\n"
+        "        )\n"
+        "    def _decode(self, data):\n"
+        "        return self.chain.types.decode_by_fork('SignedBeaconBlock', data)\n"
+        "    def _process(self, signed):\n"
+        "        # the queued process step MAY touch the chain: it runs on\n"
+        "        # a beacon_processor worker, not the reader thread\n"
+        "        self.chain.process_block(signed)\n"
+    )
+    assert lint_source(good, OUT) == []
+
+
+def test_queue_discipline_ignores_non_gossip_subscribe():
+    good = (
+        "class Bus:\n"
+        "    def __init__(self):\n"
+        "        self.events.subscribe('head', self._on_head)\n"
+        "    def _on_head(self, ev):\n"
+        "        self.chain.process_block(ev)\n"
+    )
+    assert lint_source(good, OUT) == []
+
+
+# ---------------------------------------------------------------------------
 # Suppressions
 # ---------------------------------------------------------------------------
 

@@ -223,7 +223,12 @@ def test_unknown_parent_block_recovered_via_parent_lookup():
         atts = a.make_unaggregated_attestations(tip_slot, head_root)
         before_pool = b.chain.op_pool.num_attestations()
         for att in atts[:2]:
-            nb._on_gossip_attestation(t.Attestation.serialize_value(att))
+            # the queue-routed gossip path: deliver → GOSSIP_ATTESTATION
+            # lane → batch handler parks the unknown-root attestation
+            nb.gossip._deliver(
+                nb.topic_att, t.Attestation.serialize_value(att), "test-origin"
+            )
+        assert nb.processor.drain()
         assert b.chain.op_pool.num_attestations() == before_pool  # held
         assert nb.reprocess._by_block_root  # parked under the unknown root
 
@@ -232,7 +237,7 @@ def test_unknown_parent_block_recovered_via_parent_lookup():
         nb.connect("127.0.0.1", na.port)
         before_started = _counter("sync_lookups_started_total", kind="parent")
         before_drained = _counter("sync_lookup_reprocess_drained_total")
-        nb._on_gossip_block(signed3.serialize())
+        nb.gossip._deliver(nb.topic_block, signed3.serialize(), "test-origin")
 
         deadline = time.time() + 10
         while time.time() < deadline:
@@ -270,9 +275,13 @@ def test_gossip_block_import_drains_held_attestations():
         t = b.chain.types
         att = a.make_unaggregated_attestations(slot, a.chain.head_root)[0]
         before_pool = b.chain.op_pool.num_attestations()
-        nb._on_gossip_attestation(t.Attestation.serialize_value(att))
+        nb.gossip._deliver(
+            nb.topic_att, t.Attestation.serialize_value(att), "test-origin"
+        )
+        assert nb.processor.drain()
         assert b.chain.op_pool.num_attestations() == before_pool  # held
-        nb._on_gossip_block(signed.serialize())  # parent known: direct import
+        # parent known: direct import through the GOSSIP_BLOCK lane
+        nb.gossip._deliver(nb.topic_block, signed.serialize(), "test-origin")
         assert nb.processor.drain()
         assert b.chain.op_pool.num_attestations() > before_pool
         assert not nb.reprocess._by_block_root
