@@ -1347,6 +1347,292 @@ def bench_gossip_soak(jax):
     }
 
 
+def bench_fork_choice(jax):
+    """Array-program fork choice under a 1M-validator attestation flood:
+    per trial, EVERY validator's latest-message vote moves (strictly-newer
+    target epoch) across a forked 256-block tree via the batched
+    ingestion entry (simulated drained gossip batches of 16384, grouped
+    per head root like the network layer's (root, epoch) groups), then
+    one `get_head` applies the full 1M-vote delta round. vs_baseline is
+    the retained scalar oracle (proto_array_reference) running the SAME
+    churn on a 1/16 validator subsample, same run, scaled linearly — the
+    oracle's per-validator dict walk is O(votes), so the scaling is
+    exact. A riding differential check proves bit-identical head roots
+    and node weights at subsample size (batch vs single ingestion,
+    proposer boost on/off, equivocations)."""
+    import gc
+
+    from lighthouse_tpu.fork_choice.proto_array import ProtoArrayForkChoice
+    from lighthouse_tpu.fork_choice.proto_array_reference import (
+        ProtoArrayForkChoiceReference,
+    )
+    from lighthouse_tpu.metrics import REGISTRY
+
+    n_val = 50_000 if SMOKE else 1_000_000
+    n_blocks = 64 if SMOKE else 256
+    n_heads = min(32, n_blocks // 2)
+    batch_size = 16_384
+
+    # 0xAA prefix: an all-zero root is the proto-array's "no vote"
+    # sentinel; the anchor must be a realistic non-zero hash
+    def root_of(i):
+        return b"\xaa" + i.to_bytes(4, "big") + b"\x00" * 27
+
+    tree_rng = random.Random(7)
+    edges = [
+        (
+            i,
+            i - 1
+            if tree_rng.random() < 0.9
+            else tree_rng.randrange(max(1, i - 8), i),
+        )
+        for i in range(1, n_blocks)
+    ]
+
+    def build(cls):
+        fc = cls(root_of(0), 0, root_of(0), 0, 0)
+        for i, p in edges:
+            fc.on_block(
+                slot=i, root=root_of(i), parent_root=root_of(p),
+                state_root=root_of(i), justified_epoch=0, finalized_epoch=0,
+            )
+        return fc
+
+    heads = [root_of(i) for i in range(n_blocks - n_heads, n_blocks)]
+    rng = np.random.default_rng(11)
+    targets = rng.integers(0, n_heads, n_val).astype(np.int64)
+    balances = np.full(n_val, 32_000_000_000, dtype=np.uint64)
+
+    fc = build(ProtoArrayForkChoice)
+    epoch_counter = [0]
+
+    def run():
+        epoch_counter[0] += 1
+        epoch = epoch_counter[0]
+        for start in range(0, n_val, batch_size):
+            chunk_targets = targets[start : start + batch_size]
+            base = np.arange(
+                start, min(start + batch_size, n_val), dtype=np.int64
+            )
+            for g in range(n_heads):
+                sel = base[chunk_targets == g]
+                if sel.size:
+                    fc.process_attestation_batch(sel, heads[g], epoch)
+        fc.get_head(
+            justified_checkpoint_root=root_of(0), justified_epoch=0,
+            finalized_epoch=0, justified_state_balances=balances,
+        )
+
+    counter = REGISTRY.counter("fork_choice_votes_applied_total")
+    before_batch = counter.value(path="batch")
+    spans_before = _span_totals(
+        ("fork_choice_get_head", "delta_compute", "weight_roll", "best_child")
+    )
+    run()  # warm-up: first pass allocates the 1M-row columns
+    t = _trials(run, n=3, between=gc.collect)
+    stages = _span_deltas(
+        spans_before,
+        _span_totals(
+            (
+                "fork_choice_get_head",
+                "delta_compute",
+                "weight_roll",
+                "best_child",
+            )
+        ),
+    )
+    votes_applied = counter.value(path="batch") - before_batch
+
+    # scalar oracle on a 1/16 subsample, same churn, same run
+    sub = n_val // 16
+    ref = build(ProtoArrayForkChoiceReference)
+    bal_list = [32_000_000_000] * sub
+    ctrl_times = []
+    for trial in range(2):
+        epoch = trial + 1
+        t0 = time.perf_counter()
+        for v in range(sub):
+            ref.process_attestation(v, heads[int(targets[v])], epoch)
+        ref.get_head(
+            justified_checkpoint_root=root_of(0), justified_epoch=0,
+            finalized_epoch=0, justified_state_balances=bal_list,
+        )
+        ctrl_times.append(time.perf_counter() - t0)
+        _partial(control_trial=trial + 1, of=2, s=round(ctrl_times[-1], 4))
+    ctrl_scaled = statistics.median(ctrl_times) * 16
+
+    # riding differential: columnar vs oracle, identical subsample votes
+    dc = build(ProtoArrayForkChoice)
+    dr = build(ProtoArrayForkChoiceReference)
+    diff_bal = np.full(sub, 32_000_000_000, dtype=np.uint64)
+    for round_i, (boost, eq) in enumerate(
+        ((b"\x00" * 32, set()), (heads[0], set()), (b"\x00" * 32, {1, 5}))
+    ):
+        epoch = round_i + 1
+        idx = np.arange(sub, dtype=np.int64)
+        for g in range(n_heads):
+            sel = idx[targets[:sub] == g]
+            if sel.size:
+                dc.process_attestation_batch(sel, heads[g], epoch)
+        for v in range(sub):
+            dr.process_attestation(v, heads[int(targets[v])], epoch)
+        kw = dict(
+            justified_checkpoint_root=root_of(0), justified_epoch=0,
+            finalized_epoch=0,
+            proposer_boost_root=boost,
+            proposer_boost_amount=1_000_000_000_000 if boost != b"\x00" * 32 else 0,
+            equivocating_indices=eq,
+        )
+        h1 = dc.get_head(justified_state_balances=diff_bal, **kw)
+        h2 = dr.get_head(justified_state_balances=list(diff_bal.tolist()), **kw)
+        assert h1 == h2, "columnar vs scalar head mismatch"
+        w1 = dc.proto_array._weights[: dc.proto_array._n].tolist()
+        w2 = [n.weight for n in dr.proto_array.nodes]
+        assert w1 == w2, "columnar vs scalar weight mismatch"
+
+    return {
+        "metric": "fork_choice_get_head_ms",
+        "value": round(t["median_s"] * 1000, 2),
+        "unit": (
+            f"ms/round ({n_val} votes moved + get_head, "
+            f"{n_blocks}-block forked tree)"
+        ),
+        "vs_baseline": round(ctrl_scaled / t["median_s"], 2),
+        "baseline_control": (
+            "retained scalar oracle (proto_array_reference) on a 1/16 "
+            "validator subsample, same churn, same run, scaled x16"
+        ),
+        "config": {
+            "validators": n_val,
+            "blocks": n_blocks,
+            "vote_groups": n_heads,
+            "batch_size": batch_size,
+            "votes_applied_batch": int(votes_applied),
+            "oracle_scaled_ms": round(ctrl_scaled * 1000, 1),
+            "differential_check": "passed",
+        },
+        "stages": stages,
+        "spread": t,
+        "control_spread": {
+            "median_s": statistics.median(ctrl_times),
+            "min_s": min(ctrl_times),
+            "max_s": max(ctrl_times),
+            "trials": len(ctrl_times),
+        },
+    }
+
+
+def bench_op_pool(jax):
+    """Columnar op-pool block packing under a 500k-attestation pool:
+    `get_attestations_for_block` as a flat array program (pre-grouped
+    buckets, resident masks, one gains vector + np.argmax per round) vs
+    the retained rescan reference — which re-hashes every candidate's
+    data root and recomputes the full gains list per round — on a 1/16
+    bucket subsample, same run, scaled linearly (both walks are
+    O(candidates x rounds)). A riding differential check proves the flat
+    pack and the rescan pack choose the IDENTICAL attestation list on
+    the same subsample pool."""
+    import gc
+
+    from lighthouse_tpu.beacon_chain.op_pool import OperationPool
+    from lighthouse_tpu.types.containers import build_types
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    n_pool = 20_000 if SMOKE else 500_000
+    per_bucket = OperationPool.MAX_AGGREGATES_PER_DATA  # 16
+    n_buckets = n_pool // per_bucket
+    width = 128  # mainnet-shaped committee
+
+    state, spec, _ = _build_epoch_state(64, resident=True)
+    state.slot = int(state.slot) + 1
+    t_types = build_types(E)
+    current_epoch = int(state.slot) // E.SLOTS_PER_EPOCH
+    source = state.current_justified_checkpoint
+    target = t_types.Checkpoint(epoch=current_epoch, root=b"\x22" * 32)
+
+    pool = OperationPool(spec, E)
+    rng = np.random.default_rng(3)
+    build_t0 = time.perf_counter()
+    slots = [int(state.slot) - 1 - (i % 4) for i in range(n_buckets)]
+    for b in range(n_buckets):
+        data = t_types.AttestationData(
+            slot=slots[b],
+            index=b,
+            beacon_block_root=b"\x11" * 32,
+            source=source,
+            target=target,
+        )
+        patterns = rng.random((per_bucket, width)) < 0.25
+        patterns[:, 0] = True  # overlap on bit 0: no merges, no BLS
+        for j in range(per_bucket):
+            pool._add_unmerged(
+                t_types.Attestation(
+                    aggregation_bits=patterns[j].tolist(),
+                    data=data,
+                    signature=b"\x00" * 96,
+                )
+            )
+        if b and b % 8192 == 0:
+            _partial(pool_build_buckets=b, of=n_buckets)
+    build_s = time.perf_counter() - build_t0
+    assert pool.num_attestations() == n_pool
+
+    def run():
+        packed = pool.get_attestations_for_block(state)
+        assert 0 < len(packed) <= E.MAX_ATTESTATIONS
+
+    run()  # warm-up (numpy allocators)
+    t = _trials(run, n=3, between=gc.collect)
+
+    # rescan reference on a 1/16 bucket subsample, same run
+    sub = OperationPool(spec, E)
+    sub._attestations = {
+        k: v
+        for i, (k, v) in enumerate(pool._attestations.items())
+        if i % 16 == 0
+    }
+    ctrl_times = []
+    for trial in range(2):
+        t0 = time.perf_counter()
+        ref_packed = sub.get_attestations_for_block_reference(state)
+        ctrl_times.append(time.perf_counter() - t0)
+        _partial(control_trial=trial + 1, of=2, s=round(ctrl_times[-1], 4))
+    ctrl_scaled = statistics.median(ctrl_times) * 16
+
+    # riding differential: flat vs rescan on the SAME subsample pool
+    assert sub.get_attestations_for_block(state) == ref_packed, (
+        "flat vs rescan pack mismatch"
+    )
+
+    return {
+        "metric": "op_pool_pack_ms",
+        "value": round(t["median_s"] * 1000, 2),
+        "unit": f"ms/pack ({n_pool}-attestation pool, {n_buckets} buckets)",
+        "vs_baseline": round(ctrl_scaled / t["median_s"], 2),
+        "baseline_control": (
+            "retained rescan walk (get_attestations_for_block_reference) "
+            "on a 1/16 bucket subsample, same run, scaled x16"
+        ),
+        "config": {
+            "pool_attestations": n_pool,
+            "buckets": n_buckets,
+            "aggregates_per_bucket": per_bucket,
+            "bits_width": width,
+            "max_attestations": E.MAX_ATTESTATIONS,
+            "pool_build_s": round(build_s, 2),
+            "rescan_scaled_ms": round(ctrl_scaled * 1000, 1),
+            "differential_check": "passed",
+        },
+        "spread": t,
+        "control_spread": {
+            "median_s": statistics.median(ctrl_times),
+            "min_s": min(ctrl_times),
+            "max_s": max(ctrl_times),
+            "trials": len(ctrl_times),
+        },
+    }
+
+
 _METRICS = {
     "merkle": bench_merkle,
     "pairing": bench_pairing,
@@ -1360,6 +1646,8 @@ _METRICS = {
     "sync_catchup": bench_sync_catchup,
     "gossip_soak": bench_gossip_soak,
     "attestation_batch": bench_attestation_batch,
+    "fork_choice": bench_fork_choice,
+    "op_pool": bench_op_pool,
 }
 
 
@@ -1514,6 +1802,12 @@ def main():
         # controls (the controls dominate: ~65k per-validator Python
         # iterations each)
         "attestation_batch": 120,
+        # 1M-vote columnar rounds are ~150 ms; the 1/16-subsample scalar
+        # oracle (62.5k dict-walked votes per round) dominates
+        "fork_choice": 120,
+        # 500k-attestation pool build (~20 s of insert-time hashing) + 3
+        # flat packs + the 31k-candidate rescan reference controls
+        "op_pool": 240,
     }
     for name, cap in secondary_caps.items():
         cap = _metric_cap(name, cap)

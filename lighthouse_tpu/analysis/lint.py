@@ -72,7 +72,16 @@ _ALLOW_RE = re.compile(
 # -- safe-arith vocabulary ---------------------------------------------------
 
 _U64_ATTRS = {"effective_balance"}
-_U64_SUBSCRIPT_BASES = {"balances", "slashings", "inactivity_scores"}
+# `_weights` / `_balances` are the fork-choice proto-array's uint64
+# columns (node weights and justified-state balances) — the PR 12 rule:
+# balance deltas are u64 quantities and must ride the checked helpers
+_U64_SUBSCRIPT_BASES = {
+    "balances",
+    "slashings",
+    "inactivity_scores",
+    "_weights",
+    "_balances",
+}
 _U64_PRODUCER_CALLS = {"load_balances", "load_inactivity_scores", "load_array"}
 _RAW_OPS = (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv)
 _OP_GLYPH = {ast.Add: "+", ast.Sub: "-", ast.Mult: "*", ast.FloorDiv: "//"}
@@ -343,7 +352,11 @@ def _walk_scope(body):
 
 
 def _check_safe_arith(tree: ast.Module, path: str) -> list[Violation]:
-    if "state_processing" not in path.replace("\\", "/"):
+    p = path.replace("\\", "/")
+    # fork_choice joined the rule's scope with the columnar proto-array
+    # (PR 12): its weight/balance columns are the same uint64 register the
+    # epoch sweeps use
+    if "state_processing" not in p and "fork_choice" not in p:
         return []
     out: list[Violation] = []
     for _scope, body in _function_scopes(tree):

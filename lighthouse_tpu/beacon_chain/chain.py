@@ -928,11 +928,28 @@ class BeaconChain:
                 attestations
             )
             with self.import_lock.acquire_write():
-                for att, res in zip(attestations, results):
-                    if not isinstance(res, Exception):
-                        self.apply_attestation_to_fork_choice(
-                            res.indexed_attestation
+                accepted = [
+                    (att, res)
+                    for att, res in zip(attestations, results)
+                    if not isinstance(res, Exception)
+                ]
+                if accepted:
+                    if self.slasher_service is not None:
+                        for _att, res in accepted:
+                            self.slasher_service.observe_indexed_attestation(
+                                res.indexed_attestation
+                            )
+                    # one vectorized vote write per (head root, target
+                    # epoch) group instead of a per-validator dict walk;
+                    # fork-choice rejection of individual attestations is
+                    # non-fatal, exactly like the old per-item try/except
+                    try:
+                        self.fork_choice.on_attestation_batch(
+                            [res.indexed_attestation for _a, res in accepted]
                         )
+                    except Exception:
+                        pass  # unviable targets are skipped, not fatal
+                    for att, _res in accepted:
                         self.op_pool.insert_attestation(att)
         return results
 

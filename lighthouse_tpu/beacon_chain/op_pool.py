@@ -3,9 +3,24 @@
 Mirrors beacon_node/operation_pool: attestations aggregated per
 AttestationData, greedy max-cover packing for block inclusion
 (max_cover.rs / attestation.rs AttMaxCover), SSZ persistence hooks.
+
+Unaggregated-attestation indexing is columnar: attestations group by
+AttestationData root AT INSERT into `_AttBucket`s that keep every
+aggregation pattern resident as a numpy bool row plus the bucket's
+running bitmask union — the greedy in-place aggregation (merge into the
+first disjoint stored aggregate) happens against those masks, so
+`get_attestations_for_block` starts from pre-unioned candidates with
+pre-decoded masks instead of re-hashing and re-decoding the raw pool:
+its max-cover runs as a flat array program (one gains vector, np.argmax
+per round, per-bucket coverage rows; a pick only dents its own bucket's
+gains, so nothing else recomputes). The pre-columnar pack walk is
+retained verbatim as `get_attestations_for_block_reference` — the
+differential oracle and the `op_pool_pack_ms` bench control.
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 from ..crypto import bls
 from ..state_processing.accessors import (
@@ -15,14 +30,55 @@ from ..state_processing.accessors import (
 )
 
 
+class _AttBucket:
+    """All pooled aggregates for one AttestationData: the shared `data`,
+    its slot (pruning key), the attestation objects, their aggregation
+    bitmasks as resident numpy bool rows (parallel to `atts`), a
+    bytes-key set for exact-duplicate rejection, and the running union of
+    every mask ever inserted (the pre-unioned coverage ceiling)."""
+
+    __slots__ = ("data", "slot", "atts", "masks", "keys", "union_mask")
+
+    def __init__(self, data, slot: int, nbits: int):
+        self.data = data
+        self.slot = slot
+        self.atts: list = []
+        self.masks: list[np.ndarray] = []
+        self.keys: set[bytes] = set()
+        self.union_mask = np.zeros(nbits, dtype=bool)
+
+    def append(self, attestation, mask: np.ndarray):
+        self.atts.append(attestation)
+        self.masks.append(mask)
+        self.keys.add(mask.tobytes())
+        self.union_mask |= mask
+
+    def replace(self, j: int, attestation, mask: np.ndarray):
+        """Drop aggregate j and install its merged successor — at the end
+        when the merged mask is new (the scalar dict's del-then-insert
+        ordering), or OVER the existing equal-mask entry when the merge
+        reproduced one (the dict assignment's dedup: the bucket must
+        never hold two aggregates with identical masks)."""
+        old = self.masks.pop(j)
+        self.atts.pop(j)
+        self.keys.discard(old.tobytes())
+        key = mask.tobytes()
+        if key in self.keys:
+            for pos, m in enumerate(self.masks):
+                if m.tobytes() == key:
+                    self.atts[pos] = attestation
+                    self.masks[pos] = mask
+                    return
+        self.append(attestation, mask)
+
+
 class OperationPool:
     def __init__(self, spec, E):
         self.spec = spec
         self.E = E
-        # data_root -> {bits_tuple: attestation}; kept disaggregated enough
-        # to re-aggregate disjoint sets at packing time
-        self._attestations: dict[bytes, dict[tuple, object]] = {}
-        self._attestation_data_slot: dict[bytes, int] = {}
+        # data_root -> _AttBucket; kept disaggregated enough to
+        # re-aggregate disjoint sets at packing time
+        self._attestations: dict[bytes, _AttBucket] = {}
         self._proposer_slashings: dict[int, object] = {}
         self._attester_slashings: list = []
         self._voluntary_exits: dict[int, object] = {}
@@ -33,45 +89,65 @@ class OperationPool:
     # reference's naive aggregation pool keeps one per data + overlap spill).
     MAX_AGGREGATES_PER_DATA = 16
 
+    def _bucket_for(self, attestation, mask: np.ndarray) -> _AttBucket:
+        data_root = attestation.data.hash_tree_root()
+        bucket = self._attestations.get(data_root)
+        if bucket is None:
+            bucket = _AttBucket(
+                attestation.data, int(attestation.data.slot), mask.size
+            )
+            self._attestations[data_root] = bucket
+        return bucket
+
     def insert_attestation(self, attestation):
         """Greedy in-place aggregation: merge into the first disjoint stored
         aggregate (replacing it), else keep standalone up to a cap — linear
-        work per insert, no combinatorial growth."""
-        data_root = attestation.data.hash_tree_root()
-        bucket = self._attestations.setdefault(data_root, {})
-        self._attestation_data_slot[data_root] = attestation.data.slot
-        key = tuple(attestation.aggregation_bits)
-        if key in bucket:
+        mask work per insert, no combinatorial growth."""
+        mask = np.asarray(attestation.aggregation_bits, dtype=bool)
+        bucket = self._bucket_for(attestation, mask)
+        if mask.tobytes() in bucket.keys:
             return
-        for other_key, other in bucket.items():
-            if not any(a and b for a, b in zip(key, other_key)):
-                merged_bits = [a or b for a, b in zip(key, other_key)]
+        for j, other_mask in enumerate(bucket.masks):
+            if mask.size == other_mask.size and not bool(
+                (mask & other_mask).any()
+            ):
+                merged_mask = mask | other_mask
                 agg = bls.AggregateSignature.from_signatures(
                     [
                         bls.Signature(attestation.signature),
-                        bls.Signature(other.signature),
+                        bls.Signature(bucket.atts[j].signature),
                     ]
                 )
                 t = type(attestation)
                 merged = t(
-                    aggregation_bits=merged_bits,
+                    aggregation_bits=merged_mask.tolist(),
                     data=attestation.data,
                     signature=agg.to_signature().to_bytes(),
                 )
-                del bucket[other_key]
-                bucket[tuple(merged_bits)] = merged
+                bucket.replace(j, merged, merged_mask)
                 return
-        if len(bucket) < self.MAX_AGGREGATES_PER_DATA:
-            bucket[key] = attestation
+        if len(bucket.atts) < self.MAX_AGGREGATES_PER_DATA:
+            bucket.append(attestation, mask)
+
+    def _add_unmerged(self, attestation):
+        """Insert WITHOUT the disjoint-merge scan (tests and pool-building
+        fixtures that need exact aggregation patterns preserved)."""
+        mask = np.asarray(attestation.aggregation_bits, dtype=bool)
+        bucket = self._bucket_for(attestation, mask)
+        if mask.tobytes() in bucket.keys:
+            return
+        if len(bucket.atts) < self.MAX_AGGREGATES_PER_DATA:
+            bucket.append(attestation, mask)
 
     def get_aggregate(self, data_root: bytes):
         """Best (highest-participation) running aggregate for an
         AttestationData root — the get_aggregate_attestation API surface
         aggregators read (naive aggregation pool `get`)."""
         bucket = self._attestations.get(bytes(data_root))
-        if not bucket:
+        if bucket is None or not bucket.atts:
             return None
-        return max(bucket.values(), key=lambda a: sum(a.aggregation_bits))
+        sums = [int(m.sum()) for m in bucket.masks]
+        return bucket.atts[max(range(len(sums)), key=sums.__getitem__)]
 
     def insert_proposer_slashing(self, slashing):
         self._proposer_slashings[
@@ -106,15 +182,89 @@ class OperationPool:
 
     # -- packing ------------------------------------------------------------
 
+    def _bucket_includable(self, state, bucket: _AttBucket, current, previous):
+        """The per-AttestationData inclusion filters (epoch, inclusion
+        window, FFG source) — checked ONCE per bucket instead of once per
+        pooled aggregate."""
+        E = self.E
+        data = bucket.data
+        epoch = data.target.epoch
+        if epoch not in (current, previous):
+            return False
+        if not (
+            data.slot + E.MIN_ATTESTATION_INCLUSION_DELAY
+            <= state.slot
+            <= data.slot + E.SLOTS_PER_EPOCH
+        ):
+            return False
+        return (
+            data.source == state.current_justified_checkpoint
+            if epoch == current
+            else data.source == state.previous_justified_checkpoint
+        )
+
     def get_attestations_for_block(self, state) -> list:
-        """Greedy max-cover: prefer attestations adding the most not-yet-
-        covered attesters (operation_pool/src/max_cover.rs)."""
+        """Greedy max-cover as a flat array program: one [n_candidates]
+        gains vector over the resident bucket masks, np.argmax per round,
+        per-bucket coverage rows. Coverage is per AttestationData, so a
+        pick only invalidates its OWN bucket's gains — every other
+        candidate's gain is untouched, and a round is argmax + one ≤16-row
+        recompute instead of a full-pool rescan
+        (operation_pool/src/max_cover.rs)."""
+        E = self.E
+        current = get_current_epoch(state, E)
+        previous = get_previous_epoch(state, E)
+        buckets = [
+            b
+            for b in self._attestations.values()
+            if b.atts and self._bucket_includable(state, b, current, previous)
+        ]
+        if not buckets:
+            return []
+        counts = [len(b.atts) for b in buckets]
+        n_cand = sum(counts)
+        width = max(b.union_mask.size for b in buckets)
+        matrix = np.zeros((n_cand, width), dtype=bool)
+        starts = np.zeros(len(buckets) + 1, dtype=np.int64)
+        atts_flat: list = []
+        pos = 0
+        for bi, b in enumerate(buckets):
+            k = counts[bi]
+            w = b.union_mask.size
+            matrix[pos : pos + k, :w] = np.stack(b.masks)
+            atts_flat.extend(b.atts)
+            starts[bi + 1] = pos + k
+            pos += k
+        owner_of = np.repeat(np.arange(len(buckets)), counts)
+        gains = matrix.sum(axis=1).astype(np.int64)
+        covered = np.zeros((len(buckets), width), dtype=bool)
+        taken: list[int] = []
+        chosen: list = []
+        while len(chosen) < E.MAX_ATTESTATIONS:
+            best = int(np.argmax(gains))
+            if gains[best] <= 0:
+                break
+            chosen.append(atts_flat[best])
+            taken.append(best)
+            bi = int(owner_of[best])
+            covered[bi] |= matrix[best]
+            members = slice(int(starts[bi]), int(starts[bi + 1]))
+            gains[members] = (matrix[members] & ~covered[bi]).sum(axis=1)
+            gains[taken] = -1
+        return chosen
+
+    def get_attestations_for_block_reference(self, state) -> list:
+        """The pre-columnar pack walk, retained verbatim: re-hashes every
+        candidate's data root and re-decodes its bits, then recomputes the
+        FULL gains list every round (the per-pool rescan the flat pack
+        replaced). Differential oracle + `op_pool_pack_ms` bench control —
+        do not optimize."""
         E = self.E
         current = get_current_epoch(state, E)
         previous = get_previous_epoch(state, E)
         candidates = []
-        for data_root, bucket in self._attestations.items():
-            for att in bucket.values():
+        for bucket in self._attestations.values():
+            for att in bucket.atts:
                 data = att.data
                 epoch = data.target.epoch
                 if epoch not in (current, previous):
@@ -134,11 +284,8 @@ class OperationPool:
                     candidates.append(att)
 
         # (data_root, attestation, bits) triples — roots hashed and bit
-        # lists decoded ONCE; per-round gains are then C-speed boolean
-        # kernels over numpy masks instead of Python per-bit set probes
-        # (the attestation pipeline's coverage-set representation)
-        import numpy as np
-
+        # lists decoded per pack; per-round gains are boolean kernels over
+        # numpy masks recomputed for EVERY remaining candidate
         keyed = [
             (
                 att.data.hash_tree_root(),
@@ -222,12 +369,11 @@ class OperationPool:
         previous = get_previous_epoch(state, E)
         stale = [
             dr
-            for dr, slot in self._attestation_data_slot.items()
-            if compute_epoch_at_slot(slot, E) < previous
+            for dr, bucket in self._attestations.items()
+            if compute_epoch_at_slot(bucket.slot, E) < previous
         ]
         for dr in stale:
             self._attestations.pop(dr, None)
-            self._attestation_data_slot.pop(dr, None)
 
         epoch = get_current_epoch(state, E)
         n_vals = len(state.validators)
@@ -254,4 +400,4 @@ class OperationPool:
         ]
 
     def num_attestations(self) -> int:
-        return sum(len(b) for b in self._attestations.values())
+        return sum(len(b.atts) for b in self._attestations.values())

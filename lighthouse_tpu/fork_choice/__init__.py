@@ -1,4 +1,9 @@
-"""Fork choice (consensus/{fork_choice,proto_array} equivalent)."""
+"""Fork choice (consensus/{fork_choice,proto_array} equivalent).
+
+`proto_array` is the columnar (array-program) implementation;
+`proto_array_reference` retains the scalar walk as the differential
+oracle and bench control.
+"""
 
 from .fork_choice import (
     Checkpoint,
@@ -13,6 +18,10 @@ from .proto_array import (
     ProtoArray,
     ProtoArrayError,
     ProtoArrayForkChoice,
+)
+from .proto_array_reference import (
+    ProtoArrayForkChoiceReference,
+    ProtoArrayReference,
     ProtoNode,
     VoteTracker,
 )
@@ -28,6 +37,8 @@ __all__ = [
     "ProtoArray",
     "ProtoArrayError",
     "ProtoArrayForkChoice",
+    "ProtoArrayForkChoiceReference",
+    "ProtoArrayReference",
     "ProtoNode",
     "VoteTracker",
 ]

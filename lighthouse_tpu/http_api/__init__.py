@@ -854,7 +854,7 @@ class BeaconApi:
 
         # snapshot: gossip/VC threads mutate the pool during this walk
         for bucket in list(pool._attestations.values()):
-            for att in list(bucket.values()):
+            for att in list(bucket.atts):
                 bits_t = type(att)._fields["aggregation_bits"]
                 out.append(
                     {

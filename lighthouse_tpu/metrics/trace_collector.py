@@ -39,6 +39,7 @@ ROOT_SPAN_NAMES = (
     "attestation_batch",
     "sync_range_batch",
     "api_request",
+    "fork_choice_get_head",
 )
 
 _RING_SIZE = int(os.environ.get("LIGHTHOUSE_TPU_TRACE_RING", "256"))

@@ -38,6 +38,9 @@ def pytest_sessionstart(session):
         WorkType,  # queue-wait/work histograms + depth/busy gauges
     )
     from lighthouse_tpu.crypto import bls  # noqa: F401 — registers counters
+    from lighthouse_tpu.fork_choice import (  # noqa: F401 — registers
+        proto_array,  # vote-path counter + get_head stage span histograms
+    )
     from lighthouse_tpu.metrics import REGISTRY
     from lighthouse_tpu.metrics import profiler  # noqa: F401 — registers
     from lighthouse_tpu.metrics import trace_collector  # noqa: F401 — registers
@@ -185,6 +188,17 @@ def pytest_sessionstart(session):
         'sync_service_runs_total{result="failed"}',
         "sync_service_backoff_seconds",
         'beacon_processor_queue_depth_by_kind{kind="gossip_sync_committee"}',
+        # PR 12: array-program fork choice — the vote-ingestion path
+        # counter, the get_head trace root, and its stage spans must
+        # exist at zero (the fork_choice bench stage breakdown and the
+        # perf_smoke no-scalar-fallback guard read them eagerly)
+        'fork_choice_votes_applied_total{path="batch"}',
+        'fork_choice_votes_applied_total{path="single"}',
+        'trace_collector_traces_total{root="fork_choice_get_head"}',
+        "trace_span_seconds_fork_choice_get_head",
+        "trace_span_seconds_delta_compute",
+        "trace_span_seconds_weight_roll",
+        "trace_span_seconds_best_child",
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"

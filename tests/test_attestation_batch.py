@@ -687,11 +687,12 @@ def test_op_pool_bitmask_max_cover():
     half = [i < k // 2 for i in range(k)]
     other = [i >= k // 2 for i in range(k)]
     for a in (att(half), att(other), att(full)):
-        pool._attestations.setdefault(
-            a.data.hash_tree_root(), {}
-        )[tuple(a.aggregation_bits)] = a
-        pool._attestation_data_slot[a.data.hash_tree_root()] = slot
+        # bypass the insert-time disjoint merge: max-cover must see the
+        # exact aggregation patterns, not their union
+        pool._add_unmerged(a)
     chosen = pool.get_attestations_for_block(state)
     # full covers everything; half/other add nothing afterwards
     assert len(chosen) == 1
     assert list(chosen[0].aggregation_bits) == full
+    # the retained rescan walk packs the identical set
+    assert pool.get_attestations_for_block_reference(state) == chosen

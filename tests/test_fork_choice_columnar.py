@@ -1,0 +1,556 @@
+"""Columnar proto-array fork choice vs the retained scalar oracle.
+
+Differential fuzz over randomized block trees (forks, slot skips), vote
+churn with equivocations, proposer-boost application/removal, justified-
+checkpoint flips, and prune-mid-sequence; plus the prune-under-votes
+regression (votes referencing pruned roots must resolve to the -1
+sentinel, never a stale index), batch-vs-single ingestion equivalence
+through the ForkChoice wrapper, and a perf_smoke guard that the batch
+path engages (counter check — no scalar fallback exists to fall into,
+so the guard pins the ingestion path label instead)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.fork_choice import (
+    ExecutionStatus,
+    ProtoArrayForkChoice,
+    ProtoArrayForkChoiceReference,
+)
+from lighthouse_tpu.metrics import REGISTRY
+
+# NOTE the 0xAA prefix: an all-zero anchor root would collide with the
+# "no vote yet" sentinel, making every first vote move look like a move
+# AWAY from the anchor (both implementations mirror each other on that
+# pathological input — they subtract never-added balances and raise
+# "negative node weight" identically — but real anchor roots are hashes)
+R = lambda i: b"\xaa" + i.to_bytes(4, "big") + b"\x00" * 27  # noqa: E731
+
+ZERO = b"\x00" * 32
+
+
+def _pair(prune_threshold=4):
+    col = ProtoArrayForkChoice(R(0), 0, R(0), 0, 0)
+    ref = ProtoArrayForkChoiceReference(R(0), 0, R(0), 0, 0)
+    col.proto_array.prune_threshold = prune_threshold
+    ref.proto_array.prune_threshold = prune_threshold
+    return col, ref
+
+
+def _assert_state_equal(col, ref, ctx=""):
+    pa = col.proto_array
+    n = pa._n
+    assert n == len(ref.proto_array.nodes), ctx
+    assert pa.indices == ref.proto_array.indices, ctx
+    assert pa._weights[:n].tolist() == [
+        node.weight for node in ref.proto_array.nodes
+    ], ctx
+    assert [int(x) for x in pa._best_child[:n]] == [
+        -1 if node.best_child is None else node.best_child
+        for node in ref.proto_array.nodes
+    ], ctx
+    assert [int(x) for x in pa._best_desc[:n]] == [
+        -1 if node.best_descendant is None else node.best_descendant
+        for node in ref.proto_array.nodes
+    ], ctx
+
+
+class _Fuzzer:
+    """One randomized columnar/oracle pair driven through the same op
+    sequence. Balances never increase between score passes (the valid-
+    sequence regime: the scalar oracle raises 'negative node weight' and
+    corrupts itself mid-walk otherwise — both implementations raise the
+    SAME error there, covered by a directed test below)."""
+
+    def __init__(self, seed: int, n_val: int = 48):
+        self.rng = random.Random(seed)
+        self.col, self.ref = _pair()
+        self.roots = [R(0)]
+        self.slots = {R(0): 0}
+        self.n_val = n_val
+        self.balances = [100 + self.rng.randint(0, 50) for _ in range(n_val)]
+        self.je = self.fe = 0
+        self.eq: set[int] = set()
+        self.justified_root = R(0)
+        self.next_root = 1
+        self.heads = 0
+
+    def add_block(self):
+        rng = self.rng
+        parent = rng.choice(self.roots[-8:])
+        root = R(self.next_root)
+        self.next_root += 1
+        slot = self.slots[parent] + rng.randint(1, 3)
+        self.slots[root] = slot
+        uje = rng.choice([None, self.je, self.je + 1])
+        kw = dict(
+            slot=slot,
+            root=root,
+            parent_root=parent,
+            state_root=root,
+            justified_epoch=self.je,
+            finalized_epoch=self.fe,
+            unrealized_justified_epoch=uje,
+        )
+        self.col.on_block(**kw)
+        self.ref.on_block(**kw)
+        self.roots.append(root)
+
+    def churn_votes(self):
+        rng = self.rng
+        epoch = rng.randint(0, 6)
+        target = rng.choice(self.roots)
+        vs = rng.sample(range(self.n_val), rng.randint(1, 12))
+        if rng.random() < 0.5:
+            self.col.process_attestation_batch(
+                np.asarray(vs, dtype=np.int64), target, epoch
+            )
+        else:
+            for v in vs:
+                self.col.process_attestation(v, target, epoch)
+        for v in vs:
+            self.ref.process_attestation(v, target, epoch)
+
+    def head_round(self):
+        rng = self.rng
+        if rng.random() < 0.3:
+            for _ in range(4):
+                i = rng.randrange(self.n_val)
+                self.balances[i] = max(0, self.balances[i] - rng.randint(1, 20))
+        boost_root = rng.choice(self.roots) if rng.random() < 0.4 else ZERO
+        boost = rng.randint(1, 50) if boost_root != ZERO else 0
+        if rng.random() < 0.15:
+            self.je = min(self.je + 1, 3)
+        kw = dict(
+            justified_checkpoint_root=self.justified_root,
+            justified_epoch=self.je,
+            finalized_epoch=self.fe,
+            proposer_boost_root=boost_root,
+            proposer_boost_amount=boost,
+            equivocating_indices=set(self.eq),
+        )
+        try:
+            h1 = self.col.get_head(
+                justified_state_balances=np.asarray(
+                    self.balances, dtype=np.uint64
+                ),
+                **kw,
+            )
+            e1 = None
+        except Exception as ex:  # noqa: BLE001 — compared against oracle
+            h1, e1 = None, str(ex)
+        try:
+            h2 = self.ref.get_head(
+                justified_state_balances=list(self.balances), **kw
+            )
+            e2 = None
+        except Exception as ex:  # noqa: BLE001
+            h2, e2 = None, str(ex)
+        assert (h1, e1) == (h2, e2)
+        # 'best node is not viable for head' is a legitimate matching
+        # outcome (a justified flip can orphan the whole best chain) and
+        # leaves both sides fully applied; 'negative node weight' must not
+        # occur under the non-increasing balance regime (it corrupts the
+        # scalar oracle mid-walk — directed test below)
+        assert e1 in (None, "best node is not viable for head")
+        self.heads += 1
+        _assert_state_equal(self.col, self.ref)
+
+    def prune(self):
+        fin = self.rng.choice(self.roots)
+        self.col.proto_array.maybe_prune(fin)
+        self.ref.proto_array.maybe_prune(fin)
+        assert self.col.proto_array.indices == self.ref.proto_array.indices
+        self._check_rid_invariants()
+        if self.justified_root not in self.ref.proto_array.indices:
+            self.justified_root = fin
+        self.roots = [
+            r for r in self.roots if r in self.ref.proto_array.indices
+        ]
+
+    def _check_rid_invariants(self):
+        """After a prune (which may compact the intern table): every
+        interned root maps to exactly its live node index (or -1), and
+        every vote-column rid stays in range."""
+        pa = self.col.proto_array
+        for root, rid in pa._root_ids.items():
+            assert 0 <= rid < pa._n_rids
+            expect = pa.indices.get(root, -1) if root != ZERO else -1
+            assert int(pa._rid_to_node[rid]) == expect, root.hex()
+        assert int(self.col._cur_rid.max(initial=0)) < pa._n_rids
+        assert int(self.col._next_rid.max(initial=0)) < pa._n_rids
+
+    def step(self):
+        op = self.rng.random()
+        if op < 0.35:
+            self.add_block()
+        elif op < 0.72:
+            self.churn_votes()
+        elif op < 0.78 and len(self.roots) > 3:
+            self.eq.add(self.rng.randrange(self.n_val))
+        elif op < 0.9:
+            self.head_round()
+        else:
+            self.prune()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_differential_fuzz_columnar_vs_scalar_oracle(seed):
+    f = _Fuzzer(seed)
+    for _ in range(300):
+        f.step()
+    assert f.heads >= 10  # the sequence actually exercised head selection
+
+
+def test_negative_weight_raises_identically():
+    """Balance INCREASE while a vote is parked makes the move subtract
+    more than it added — the scalar oracle raises 'negative node weight'
+    mid-walk; the columnar pass must detect the same condition (checked
+    u64 underflow, surfaced BEFORE any weight write)."""
+    col, ref = _pair()
+    for fc in (col, ref):
+        fc.on_block(
+            slot=1, root=R(1), parent_root=R(0), state_root=R(1),
+            justified_epoch=0, finalized_epoch=0,
+        )
+        fc.process_attestation(0, R(1), 1)
+        fc.get_head(
+            justified_checkpoint_root=R(0), justified_epoch=0,
+            finalized_epoch=0, justified_state_balances=[10],
+        )
+        # balance jumps 10 -> 50 while the vote stays: the pass skips the
+        # unchanged vote but records 50 as the old balance...
+        fc.process_attestation(0, R(1), 1)  # no-op (same target)
+        fc.get_head(
+            justified_checkpoint_root=R(0), justified_epoch=0,
+            finalized_epoch=0, justified_state_balances=[50],
+        )
+        # ...so moving the vote now subtracts 50 from a 10-weight node
+        fc.process_attestation(0, R(0), 2)
+        with pytest.raises(ValueError, match="negative node weight"):
+            fc.get_head(
+                justified_checkpoint_root=R(0), justified_epoch=0,
+                finalized_epoch=0, justified_state_balances=[50],
+            )
+
+
+def test_prune_under_votes_resolves_to_sentinel():
+    """Votes referencing pruned roots must resolve to the -1 sentinel,
+    not a stale (remapped) node index: after the prune drops a voted-for
+    fork, the next delta round must neither crash nor credit a surviving
+    node that inherited the pruned node's old index."""
+    col, ref = _pair(prune_threshold=0)
+    # trunk 1..5 plus a side fork F at slot 2 that prune will drop
+    fork_root = R(99)
+    for fc in (col, ref):
+        for i in range(1, 6):
+            fc.on_block(
+                slot=i, root=R(i), parent_root=R(i - 1), state_root=R(i),
+                justified_epoch=0, finalized_epoch=0,
+            )
+        fc.on_block(
+            slot=2, root=fork_root, parent_root=R(1), state_root=fork_root,
+            justified_epoch=0, finalized_epoch=0,
+        )
+        # validator 0 votes the doomed fork; validator 1 (heavier) the
+        # trunk tip, so the trunk wins and the fork gets pruned away
+        fc.process_attestation(0, fork_root, 1)
+        fc.process_attestation(1, R(5), 1)
+        assert fc.get_head(
+            justified_checkpoint_root=R(0), justified_epoch=0,
+            finalized_epoch=0, justified_state_balances=[10, 20],
+        ) == R(5)
+        fc.proto_array.maybe_prune(R(3))
+        assert not fc.contains_block(fork_root)
+    # the interned fork root now maps to the sentinel, NOT a live index
+    pa = col.proto_array
+    rid = pa._root_ids[fork_root]
+    assert int(pa._rid_to_node[rid]) == -1
+    # a later round (vote 0 moves off the pruned root) stays bit-identical
+    for fc in (col, ref):
+        fc.process_attestation(0, R(5), 2)
+        assert fc.get_head(
+            justified_checkpoint_root=R(3), justified_epoch=0,
+            finalized_epoch=0, justified_state_balances=[10, 20],
+        ) == R(5)
+    _assert_state_equal(col, ref)
+    # once no vote column references the pruned root anymore, the next
+    # prune compacts its intern entry away entirely (no unbounded growth
+    # of the rid table on a long-lived node)
+    assert fork_root in pa._root_ids  # still interned: was referenced
+    col.proto_array.maybe_prune(R(5))
+    ref.proto_array.maybe_prune(R(5))
+    assert fork_root not in pa._root_ids
+    assert R(5) in pa._root_ids  # live vote target survives, remapped
+    rid5 = pa._root_ids[R(5)]
+    assert int(pa._rid_to_node[rid5]) == pa.indices[R(5)]
+    for fc in (col, ref):
+        assert fc.get_head(
+            justified_checkpoint_root=R(5), justified_epoch=0,
+            finalized_epoch=0, justified_state_balances=[10, 20],
+        ) == R(5)
+    _assert_state_equal(col, ref)
+
+
+def test_pruned_root_readded_resolves_to_new_index():
+    """A root voted for before its block is known (direct proto API) must
+    resolve once the block arrives — the rid map is refreshed on insert."""
+    col, _ = _pair()
+    col.process_attestation(0, R(7), 1)  # unknown root: parked at sentinel
+    pa = col.proto_array
+    assert int(pa._rid_to_node[pa._root_ids[R(7)]]) == -1
+    col.on_block(
+        slot=1, root=R(7), parent_root=R(0), state_root=R(7),
+        justified_epoch=0, finalized_epoch=0,
+    )
+    assert int(pa._rid_to_node[pa._root_ids[R(7)]]) == pa.indices[R(7)]
+    assert col.get_head(
+        justified_checkpoint_root=R(0), justified_epoch=0,
+        finalized_epoch=0, justified_state_balances=[10],
+    ) == R(7)
+
+
+def test_execution_invalidation_matches_oracle():
+    col, ref = _pair()
+    for fc in (col, ref):
+        for i in range(1, 5):
+            fc.on_block(
+                slot=i, root=R(i), parent_root=R(i - 1), state_root=R(i),
+                justified_epoch=0, finalized_epoch=0,
+                execution_status=ExecutionStatus.OPTIMISTIC,
+            )
+        fc.process_attestation(0, R(4), 1)
+        assert fc.get_head(
+            justified_checkpoint_root=R(0), justified_epoch=0,
+            finalized_epoch=0, justified_state_balances=[10],
+        ) == R(4)
+        fc.proto_array.invalidate_block(R(3))
+        assert fc.get_head(
+            justified_checkpoint_root=R(0), justified_epoch=0,
+            finalized_epoch=0, justified_state_balances=[10],
+        ) == R(2)
+    _assert_state_equal(col, ref)
+    col.proto_array.propagate_execution_payload_validity(R(2))
+    assert (
+        col.proto_array.execution_status_of(R(2)) == ExecutionStatus.VALID
+    )
+    assert (
+        col.proto_array.execution_status_of(R(3)) == ExecutionStatus.INVALID
+    )
+
+
+def test_batch_ingestion_equals_single():
+    """process_attestation_batch must leave the vote columns exactly as
+    the equivalent sequence of single-vote calls (including the strictly-
+    newer-epoch accept rule and the first-vote default case)."""
+    batch, single = (
+        ProtoArrayForkChoice(R(0), 0, R(0), 0, 0),
+        ProtoArrayForkChoice(R(0), 0, R(0), 0, 0),
+    )
+    for fc in (batch, single):
+        fc.on_block(
+            slot=1, root=R(1), parent_root=R(0), state_root=R(1),
+            justified_epoch=0, finalized_epoch=0,
+        )
+        fc.on_block(
+            slot=1, root=R(2), parent_root=R(0), state_root=R(2),
+            justified_epoch=0, finalized_epoch=0,
+        )
+    rng = random.Random(5)
+    for round_ in range(20):
+        epoch = rng.randint(0, 5)
+        target = rng.choice([R(1), R(2)])
+        vs = rng.sample(range(64), rng.randint(1, 16))
+        batch.process_attestation_batch(
+            np.asarray(vs, dtype=np.int64), target, epoch
+        )
+        for v in vs:
+            single.process_attestation(v, target, epoch)
+        m = len(single._cur_rid)
+        assert batch._next_rid[:m].tolist() == single._next_rid[:m].tolist()
+        assert (
+            batch._next_epoch[:m].tolist() == single._next_epoch[:m].tolist()
+        )
+    balances = np.full(64, 7, dtype=np.uint64)
+    assert batch.get_head(
+        justified_checkpoint_root=R(0), justified_epoch=0, finalized_epoch=0,
+        justified_state_balances=balances,
+    ) == single.get_head(
+        justified_checkpoint_root=R(0), justified_epoch=0, finalized_epoch=0,
+        justified_state_balances=balances,
+    )
+
+
+# ---------------------------------------------------------------------------
+# ForkChoice wrapper batch entry
+# ---------------------------------------------------------------------------
+
+from lighthouse_tpu.fork_choice.fork_choice import (  # noqa: E402
+    Checkpoint as FcCheckpoint,
+    ForkChoice,
+    ForkChoiceStore,
+    InvalidAttestation,
+)
+from lighthouse_tpu.types.chain_spec import minimal_spec  # noqa: E402
+from lighthouse_tpu.types.containers import build_types  # noqa: E402
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec  # noqa: E402
+
+
+def _wrapper(current_slot=0):
+    cp = FcCheckpoint(epoch=0, root=R(0))
+    store = ForkChoiceStore(
+        current_slot=current_slot,
+        justified_checkpoint=cp,
+        finalized_checkpoint=cp,
+        unrealized_justified_checkpoint=cp,
+        unrealized_finalized_checkpoint=cp,
+    )
+    proto = ProtoArrayForkChoice(R(0), 0, R(0), 0, 0)
+    return ForkChoice(store, proto, minimal_spec(), MinimalEthSpec)
+
+
+def _indexed(T, slot, head_root, target_epoch, target_root, indices):
+    return T.IndexedAttestation(
+        attesting_indices=list(indices),
+        data=T.AttestationData(
+            slot=slot,
+            index=0,
+            beacon_block_root=head_root,
+            source=T.Checkpoint(epoch=0, root=R(0)),
+            target=T.Checkpoint(epoch=target_epoch, root=target_root),
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def test_on_attestation_batch_validates_groups_and_filters_equivocators():
+    T = build_types(MinimalEthSpec)
+    E = MinimalEthSpec
+    fc = _wrapper(current_slot=E.SLOTS_PER_EPOCH + 2)
+    fc.proto.on_block(
+        slot=1, root=R(1), parent_root=R(0), state_root=R(1),
+        justified_epoch=0, finalized_epoch=0,
+    )
+    e1 = E.SLOTS_PER_EPOCH
+    fc.proto.on_block(
+        slot=e1, root=R(2), parent_root=R(1), state_root=R(2),
+        justified_epoch=0, finalized_epoch=0,
+    )
+    fc.store.equivocating_indices.add(3)
+    slot = e1 + 1
+    batch = [
+        _indexed(T, slot, R(2), 1, R(2), (0, 1, 3)),   # valid; 3 equivocates
+        _indexed(T, slot, R(2), 1, R(1), (4,)),        # FFG-inconsistent
+        _indexed(T, slot, R(2), 1, R(2), (5, 6)),      # valid, same group
+    ]
+    counter = REGISTRY.counter("fork_choice_votes_applied_total")
+    before = counter.value(path="batch")
+    results = fc.on_attestation_batch(batch)
+    assert results[0] is None and results[2] is None
+    assert isinstance(results[1], InvalidAttestation)
+    # 4 accepted votes (0, 1, 5, 6) in ONE grouped vectorized write; the
+    # equivocating validator's vote never lands
+    assert counter.value(path="batch") - before == 4
+    proto = fc.proto
+    rid = proto.proto_array._root_ids[R(2)]
+    assert proto._next_rid[0] == rid and proto._next_rid[5] == rid
+    assert int(proto._next_rid[3]) == 0
+    assert int(proto._next_rid[4]) == 0
+
+
+def test_on_attestation_batch_matches_sequential_on_attestation():
+    T = build_types(MinimalEthSpec)
+    E = MinimalEthSpec
+    a, b = (
+        _wrapper(current_slot=E.SLOTS_PER_EPOCH + 2),
+        _wrapper(current_slot=E.SLOTS_PER_EPOCH + 2),
+    )
+    for fc in (a, b):
+        fc.proto.on_block(
+            slot=1, root=R(1), parent_root=R(0), state_root=R(1),
+            justified_epoch=0, finalized_epoch=0,
+        )
+        fc.proto.on_block(
+            slot=E.SLOTS_PER_EPOCH, root=R(2), parent_root=R(1),
+            state_root=R(2), justified_epoch=0, finalized_epoch=0,
+        )
+    slot = E.SLOTS_PER_EPOCH + 1
+    batch = [
+        _indexed(T, slot, R(2), 1, R(2), (0, 1, 2)),
+        _indexed(T, slot, R(2), 1, R(2), (2, 5)),
+    ]
+    a.on_attestation_batch(batch)
+    for ia in batch:
+        b.on_attestation(ia)
+    m = len(b.proto._next_rid)
+    assert a.proto._next_rid[:m].tolist() == b.proto._next_rid[:m].tolist()
+    assert (
+        a.proto._next_epoch[:m].tolist() == b.proto._next_epoch[:m].tolist()
+    )
+
+
+# ---------------------------------------------------------------------------
+# perf_smoke: the columnar path engages
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.perf_smoke
+def test_perf_smoke_batch_path_engages_and_stays_flat():
+    """100k votes ingested through the batch entry + one get_head: the
+    batch counter must account for every vote (no per-validator single
+    fallback), the get_head stage spans must fire, and the wall clock
+    stays array-program flat."""
+    import time
+
+    n_val = 100_000
+    fc = ProtoArrayForkChoice(R(0), 0, R(0), 0, 0)
+    for i in range(1, 17):
+        fc.on_block(
+            slot=i, root=R(i), parent_root=R(i - 1), state_root=R(i),
+            justified_epoch=0, finalized_epoch=0,
+        )
+    counter = REGISTRY.counter("fork_choice_votes_applied_total")
+    b_batch = counter.value(path="batch")
+    b_single = counter.value(path="single")
+    span_count = REGISTRY.histogram("trace_span_seconds_delta_compute").count
+    balances = np.full(n_val, 32_000_000_000, dtype=np.uint64)
+    idx = np.arange(n_val, dtype=np.int64)
+    t0 = time.perf_counter()
+    for start in range(0, n_val, 16384):
+        fc.process_attestation_batch(
+            idx[start : start + 16384], R(16), 1
+        )
+    head = fc.get_head(
+        justified_checkpoint_root=R(0), justified_epoch=0,
+        finalized_epoch=0, justified_state_balances=balances,
+    )
+    elapsed = time.perf_counter() - t0
+    assert head == R(16)
+    assert counter.value(path="batch") - b_batch == n_val
+    assert counter.value(path="single") - b_single == 0
+    assert (
+        REGISTRY.histogram("trace_span_seconds_delta_compute").count
+        > span_count
+    )
+    # generous bound: the scalar oracle needs seconds for the same work
+    assert elapsed < 1.5, f"batch ingest + get_head took {elapsed:.2f}s"
+
+
+def test_balances_held_without_copy():
+    """The proto-array must hold the caller's uint64 balance array by
+    reference (the scalar oracle copied a full Python list per get_head);
+    the wrapper replaces the array wholesale on justified changes, so no
+    copy is needed on the steady path."""
+    fc = ProtoArrayForkChoice(R(0), 0, R(0), 0, 0)
+    fc.on_block(
+        slot=1, root=R(1), parent_root=R(0), state_root=R(1),
+        justified_epoch=0, finalized_epoch=0,
+    )
+    balances = np.full(8, 10, dtype=np.uint64)
+    fc.get_head(
+        justified_checkpoint_root=R(0), justified_epoch=0,
+        finalized_epoch=0, justified_state_balances=balances,
+    )
+    assert fc.balances is balances

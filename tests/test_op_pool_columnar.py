@@ -1,0 +1,231 @@
+"""Columnar op-pool attestation indexing: bucket-per-data-root with
+resident numpy masks + insert-time union, flat max-cover packing vs the
+retained rescan reference, merge/dedup/cap behavior, get_aggregate, and
+pruning over the bucket structure.
+
+Contract (op_pool.py): `get_attestations_for_block` must return the
+EXACT list the retained `get_attestations_for_block_reference` walk
+returns — same attestations, same order — for any pool content and any
+state, because both implement the same greedy max-cover (first maximal
+gain in candidate order, per-data coverage, zero-gain stop)."""
+
+import random
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.beacon_chain.op_pool import OperationPool
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_processing import interop_genesis_state
+from lighthouse_tpu.state_processing.accessors import get_current_epoch
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.containers import build_types
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+T = build_types(E)
+
+
+@pytest.fixture(scope="module")
+def state():
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    st = interop_genesis_state(
+        bls.interop_keypairs(16), 1_600_000_000, b"\x42" * 32, spec, E
+    )
+    # deep enough that out-of-window and ancient-target fixtures have
+    # room below slot 0 / epoch 0
+    st.slot = 3 * E.SLOTS_PER_EPOCH + 2
+    return st
+
+
+def _att(state, slot, index, bits, target_epoch=None, source=None):
+    current = get_current_epoch(state, E)
+    return T.Attestation(
+        aggregation_bits=bits,
+        data=T.AttestationData(
+            slot=slot,
+            index=index,
+            beacon_block_root=b"\x11" * 32,
+            source=source if source is not None
+            else state.current_justified_checkpoint,
+            target=T.Checkpoint(
+                epoch=current if target_epoch is None else target_epoch,
+                root=b"\x22" * 32,
+            ),
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def _random_pool(state, rng, n_buckets=24, width=16):
+    """A pool of randomized buckets: in-window and out-of-window slots,
+    current/previous/ancient targets, wrong sources — the pack filters
+    must agree bucket-wise with the reference's per-attestation checks."""
+    pool = OperationPool(state_spec(state), E)
+    current = get_current_epoch(state, E)
+    for b in range(n_buckets):
+        kind = rng.random()
+        if kind < 0.6:
+            slot = int(state.slot) - rng.randint(1, 6)  # in window
+            target_epoch = None
+            source = None
+        elif kind < 0.75:
+            slot = int(state.slot) - rng.randint(9, 12)  # outside window
+            target_epoch = None
+            source = None
+        elif kind < 0.9:
+            slot = int(state.slot) - rng.randint(1, 6)
+            target_epoch = current - 2  # too-old target epoch
+            source = None
+        else:
+            slot = int(state.slot) - rng.randint(1, 6)
+            target_epoch = None
+            source = T.Checkpoint(epoch=7, root=b"\x99" * 32)  # bad source
+        for _ in range(rng.randint(1, 6)):
+            bits = [rng.random() < 0.4 for _ in range(width)]
+            if not any(bits):
+                bits[rng.randrange(width)] = True
+            pool._add_unmerged(
+                _att(state, slot, b, bits, target_epoch, source)
+            )
+    return pool
+
+
+def state_spec(state):
+    return replace(minimal_spec(), altair_fork_epoch=0)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_pack_differential_vs_reference(state, seed):
+    rng = random.Random(seed)
+    pool = _random_pool(state, rng)
+    flat = pool.get_attestations_for_block(state)
+    rescan = pool.get_attestations_for_block_reference(state)
+    assert flat == rescan  # same objects, same order
+    assert len(flat) <= E.MAX_ATTESTATIONS
+    # a second pack is idempotent (packing is read-only)
+    assert pool.get_attestations_for_block(state) == flat
+
+
+def test_pack_respects_max_attestations(state):
+    pool = OperationPool(state_spec(state), E)
+    rng = random.Random(1)
+    # more disjoint-singleton buckets than a block can carry
+    for b in range(E.MAX_ATTESTATIONS + 8):
+        bits = [i == (b % 16) for i in range(16)]
+        pool._add_unmerged(_att(state, int(state.slot) - 1, b, bits))
+    chosen = pool.get_attestations_for_block(state)
+    assert len(chosen) == E.MAX_ATTESTATIONS
+    assert chosen == pool.get_attestations_for_block_reference(state)
+
+
+def test_insert_merges_first_disjoint_aggregate(state):
+    """Greedy in-place aggregation: two disjoint patterns for the same
+    data collapse into their union (one stored aggregate, signature
+    aggregated), and the bucket's union mask tracks every insert."""
+    kp = bls.interop_keypairs(2)
+    pool = OperationPool(state_spec(state), E)
+    half = [i < 8 for i in range(16)]
+    other = [i >= 8 for i in range(16)]
+    sig1 = kp[0].sk.sign(b"m1").to_bytes()
+    sig2 = kp[1].sk.sign(b"m2").to_bytes()
+    a1 = _att(state, int(state.slot) - 1, 0, half)
+    a1 = T.Attestation(
+        aggregation_bits=half, data=a1.data, signature=sig1
+    )
+    a2 = T.Attestation(
+        aggregation_bits=other, data=a1.data, signature=sig2
+    )
+    pool.insert_attestation(a1)
+    pool.insert_attestation(a2)
+    assert pool.num_attestations() == 1
+    merged = pool.get_aggregate(a1.data.hash_tree_root())
+    assert list(merged.aggregation_bits) == [True] * 16
+    (bucket,) = pool._attestations.values()
+    assert bucket.union_mask.all()
+    # exact duplicates are rejected without growing the bucket
+    pool.insert_attestation(
+        T.Attestation(
+            aggregation_bits=[True] * 16, data=a1.data, signature=sig1
+        )
+    )
+    assert pool.num_attestations() == 1
+
+
+def test_merge_reproducing_existing_mask_dedupes(state):
+    """A disjoint merge whose union equals an ALREADY-stored aggregate
+    must replace that entry, not append a twin (the scalar dict's
+    assignment dedup): bucket holds A=10, B=11; inserting C=01 merges
+    with A into 11 == B -> exactly ONE stored aggregate remains."""
+    kp = bls.interop_keypairs(3)
+    pool = OperationPool(state_spec(state), E)
+    base = _att(state, int(state.slot) - 1, 0, [True, False])
+    def with_bits(bits, sk):
+        return T.Attestation(
+            aggregation_bits=bits, data=base.data,
+            signature=sk.sign(b"x").to_bytes(),
+        )
+    pool.insert_attestation(with_bits([True, False], kp[0].sk))   # A=10
+    pool._add_unmerged(with_bits([True, True], kp[1].sk))         # B=11
+    assert pool.num_attestations() == 2
+    pool.insert_attestation(with_bits([False, True], kp[2].sk))   # C=01
+    assert pool.num_attestations() == 1
+    (bucket,) = pool._attestations.values()
+    assert [m.tolist() for m in bucket.masks] == [[True, True]]
+    assert bucket.keys == {bucket.masks[0].tobytes()}
+    # and an exact duplicate of the survivor is still rejected
+    pool.insert_attestation(with_bits([True, True], kp[1].sk))
+    assert pool.num_attestations() == 1
+
+
+def test_insert_cap_bounds_bucket(state):
+    pool = OperationPool(state_spec(state), E)
+    # overlapping patterns (all share bit 0) never merge: the cap holds
+    for j in range(OperationPool.MAX_AGGREGATES_PER_DATA + 8):
+        bits = [True] + [i == j for i in range(40)]
+        pool._add_unmerged(_att(state, int(state.slot) - 1, 0, bits))
+    assert (
+        pool.num_attestations() == OperationPool.MAX_AGGREGATES_PER_DATA
+    )
+
+
+def test_get_aggregate_prefers_highest_participation(state):
+    pool = OperationPool(state_spec(state), E)
+    small = [i < 2 for i in range(16)]
+    big = [i < 9 for i in range(16)]
+    a = _att(state, int(state.slot) - 1, 0, small)
+    pool._add_unmerged(a)
+    pool._add_unmerged(
+        T.Attestation(
+            aggregation_bits=big, data=a.data, signature=b"\x00" * 96
+        )
+    )
+    got = pool.get_aggregate(a.data.hash_tree_root())
+    assert list(got.aggregation_bits) == big
+    assert pool.get_aggregate(b"\x77" * 32) is None
+
+
+def test_prune_drops_stale_buckets(state):
+    pool = OperationPool(state_spec(state), E)
+    fresh = _att(state, int(state.slot) - 1, 0, [True] * 16)
+    # two epochs back: below the previous-epoch retention line
+    stale = _att(
+        state, int(state.slot) - 2 * E.SLOTS_PER_EPOCH - 1, 1, [True] * 16
+    )
+    pool._add_unmerged(fresh)
+    pool._add_unmerged(stale)
+    assert pool.num_attestations() == 2
+    pool.prune(state)
+    assert pool.num_attestations() == 1
+    assert pool.get_aggregate(fresh.data.hash_tree_root()) is not None
+    assert pool.get_aggregate(stale.data.hash_tree_root()) is None
+
+
+def test_empty_pool_and_all_filtered_pool_pack_empty(state):
+    pool = OperationPool(state_spec(state), E)
+    assert pool.get_attestations_for_block(state) == []
+    pool._add_unmerged(
+        _att(state, int(state.slot) - 10, 0, [True] * 16)  # out of window
+    )
+    assert pool.get_attestations_for_block(state) == []
+    assert pool.get_attestations_for_block_reference(state) == []

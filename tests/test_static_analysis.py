@@ -78,6 +78,49 @@ def test_safe_arith_scoped_to_state_processing():
     assert lint_source(outside, OUT) == []
 
 
+# a synthetic path inside fork_choice/ — in the safe-arith scope since the
+# columnar proto-array (PR 12: weight/balance columns are u64 quantities)
+FC = "lighthouse_tpu/fork_choice/_fixture.py"
+
+
+def test_safe_arith_fires_on_fork_choice_weight_columns():
+    bad = (
+        "def f(self, i, delta):\n"
+        "    self._weights[i] = self._weights[i] + delta\n"
+    )
+    assert _rules(lint_source(bad, FC)) == ["safe-arith"]
+
+
+def test_safe_arith_fires_on_fork_choice_balance_taint():
+    bad = (
+        "def f(self, vi, boost):\n"
+        "    old = self._balances[vi]\n"
+        "    return old * boost\n"
+    )
+    assert _rules(lint_source(bad, FC)) == ["safe-arith"]
+
+
+def test_safe_arith_clean_fork_choice_routed_through_vector_helpers():
+    good = (
+        "from lighthouse_tpu.utils.safe_arith import add_u64, sub_u64\n"
+        "def f(self, n, pos, neg):\n"
+        "    total = add_u64(self._weights[:n], pos)\n"
+        "    self._weights[:n] = sub_u64(total, neg)\n"
+    )
+    assert lint_source(good, FC) == []
+
+
+def test_cow_aliasing_fires_on_attesting_index_view_write_in_fork_choice():
+    # the batch entry reads attesting_indices.load_array() — a frozen
+    # CoW view; writing it must fire regardless of the module's path
+    bad = (
+        "def f(indexed):\n"
+        "    v = indexed.attesting_indices.load_array()\n"
+        "    v[0] = 7\n"
+    )
+    assert _rules(lint_source(bad, FC)) == ["cow-aliasing"]
+
+
 # ---------------------------------------------------------------------------
 # cow-aliasing
 # ---------------------------------------------------------------------------
