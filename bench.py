@@ -1633,6 +1633,228 @@ def bench_op_pool(jax):
     }
 
 
+def bench_slasher_ingest(jax):
+    """Columnar slasher ingesting ONE EPOCH's full mainnet-shape
+    attestation flood at 1M validators (2048 aggregates, ~490-member
+    committees, every validator attesting once): per trial, a pre-warmed
+    engine (one prior epoch recorded, so min/max spans and record columns
+    are populated) consumes the flood queue in one `process_queued` cycle
+    — batched data-root hashing, grouped span gather/compare, bulk
+    span writebacks. A seeded-slashing RECALL check rides every trial: a
+    planted double vote plus surrounds in BOTH directions must all be
+    found, exactly, with zero false emissions. vs_baseline is the
+    retained scalar engine (slasher/reference.py) on a 1/16 validator
+    subsample — same committee size, 1/16 of the committees, same warm
+    epoch — same run, scaled linearly (the scalar walk is O(attesting
+    indices)). A riding differential check proves columnar ≡ scalar
+    emissions on the subsample flood incl. the planted offenders."""
+    import gc
+
+    from lighthouse_tpu.metrics import REGISTRY
+    from lighthouse_tpu.slasher.columnar import ColumnarSlasher
+    from lighthouse_tpu.slasher.reference import ReferenceSlasher
+    from lighthouse_tpu.types.containers import build_types
+    from lighthouse_tpu.types.eth_spec import MainnetEthSpec as E
+
+    T = build_types(E)
+    n_val = 65_536 if SMOKE else 1_000_000
+    n_comm = 128 if SMOKE else 2048  # 64 committees x 32 slots
+    warm_epoch, flood_epoch = 10, 11
+    # planted offenders: victims of a double vote and both surround
+    # directions, detected DURING the timed flood cycle
+    v_double, v_surrounded, v_surrounder = 100, 200, 300
+
+    def make_flood(source, target, n_validators, committees, seed):
+        rng = np.random.default_rng(seed)
+        chunks = np.array_split(rng.permutation(n_validators), committees)
+        cp = T.Checkpoint(epoch=source, root=b"\x01" * 32)
+        ct = T.Checkpoint(epoch=target, root=b"\x02" * 32)
+        return [
+            T.IndexedAttestation(
+                attesting_indices=np.sort(ch).tolist(),
+                data=T.AttestationData(
+                    slot=target * E.SLOTS_PER_EPOCH + (i % E.SLOTS_PER_EPOCH),
+                    index=i // E.SLOTS_PER_EPOCH,
+                    beacon_block_root=b"\x03" * 32,
+                    source=cp,
+                    target=ct,
+                ),
+                signature=b"\x00" * 96,
+            )
+            for i, ch in enumerate(chunks)
+        ]
+
+    def single(vi, source, target, root):
+        return T.IndexedAttestation(
+            attesting_indices=[vi],
+            data=T.AttestationData(
+                slot=target * E.SLOTS_PER_EPOCH,
+                index=0,
+                beacon_block_root=root,
+                source=T.Checkpoint(epoch=source, root=b"\x01" * 32),
+                target=T.Checkpoint(epoch=target, root=b"\x01" * 32),
+            ),
+            signature=b"\x00" * 96,
+        )
+
+    def planted_warm():
+        # v_surrounded's wide old record will surround its own honest
+        # flood vote; v_surrounder's narrow record gets surrounded by a
+        # planted attacker vote in the flood
+        return [
+            single(v_surrounded, 8, 13, b"\xaa" * 32),
+            single(v_surrounder, 11, 12, b"\xbb" * 32),
+        ]
+
+    def planted_flood():
+        return [
+            single(v_double, warm_epoch, flood_epoch, b"\xcc" * 32),
+            single(v_surrounder, 10, 13, b"\xdd" * 32),
+        ]
+
+    build_t0 = time.perf_counter()
+    warm = make_flood(warm_epoch - 1, warm_epoch, n_val, n_comm, seed=1)
+    flood = make_flood(warm_epoch, flood_epoch, n_val, n_comm, seed=2)
+    build_s = time.perf_counter() - build_t0
+    n_atts = len(flood) + len(planted_flood())
+
+    trials = 3
+    _partial(stage="warming", engines=trials)
+    engines = []
+    for _ in range(trials):
+        s = ColumnarSlasher(E)
+        for a in warm + planted_warm():
+            s.accept_attestation(a)
+        s.process_queued(warm_epoch)  # untimed: prior-epoch span state
+        s.drain_slashings()  # discard warm-cycle findings (the planted
+        # wide record itself surrounds its victim's honest warm vote);
+        # the timed cycle must find exactly the three planted offenders
+        engines.append(s)
+
+    scans = REGISTRY.counter("slasher_exact_scans_total")
+    spans_before = _span_totals(
+        ("slasher_process", "span_gather", "span_compare", "span_update", "persist")
+    )
+    scans_before = scans.value()
+    recall = {}
+
+    def run():
+        s = engines.pop()
+        for a in flood + planted_flood():
+            s.accept_attestation(a)
+        out = s.process_queued(flood_epoch)
+        # riding recall assertion: all three planted offenders, nothing else
+        assert out["attester_slashings"] == 3, out
+        atts, _ = s.drain_slashings()
+        offenders = {
+            int(
+                (
+                    set(a.attestation_1.attesting_indices)
+                    & set(a.attestation_2.attesting_indices)
+                ).pop()
+            )
+            for a in atts
+        }
+        assert offenders == {v_double, v_surrounded, v_surrounder}, offenders
+        recall["planted"] = 3
+        recall["found"] = len(atts)
+
+    t = _trials(run, n=trials, between=gc.collect)
+    stages = _span_deltas(
+        spans_before,
+        _span_totals(
+            (
+                "slasher_process",
+                "span_gather",
+                "span_compare",
+                "span_update",
+                "persist",
+            )
+        ),
+    )
+    exact_scans = scans.value() - scans_before
+
+    # scalar reference on a 1/16 subsample: same committee size, 1/16 of
+    # the committees (both per-item and per-index costs scale linearly)
+    sub_val, sub_comm = n_val // 16, n_comm // 16
+    sub_warm = make_flood(warm_epoch - 1, warm_epoch, sub_val, sub_comm, seed=1)
+    sub_flood = make_flood(warm_epoch, flood_epoch, sub_val, sub_comm, seed=2)
+    ctrl_times = []
+    for trial in range(2):
+        r = ReferenceSlasher(E)
+        for a in sub_warm + planted_warm():
+            r.accept_attestation(a)
+        r.process_queued(warm_epoch)
+        r.drain_slashings()
+        for a in sub_flood + planted_flood():
+            r.accept_attestation(a)
+        t0 = time.perf_counter()
+        out = r.process_queued(flood_epoch)
+        ctrl_times.append(time.perf_counter() - t0)
+        assert out["attester_slashings"] == 3, out
+        _partial(control_trial=trial + 1, of=2, s=round(ctrl_times[-1], 4))
+    ctrl_scaled = statistics.median(ctrl_times) * 16
+
+    # riding differential: columnar vs scalar on the SAME subsample flood
+    dc = ColumnarSlasher(E)
+    dr = ReferenceSlasher(E)
+    for engine in (dc, dr):
+        for a in sub_warm + planted_warm():
+            engine.accept_attestation(a)
+        engine.process_queued(warm_epoch)
+        for a in sub_flood + planted_flood():
+            engine.accept_attestation(a)
+        engine.process_queued(flood_epoch)
+        # fingerprint covers BOTH cycles' emissions (warm incl. the
+        # planted wide record's own surround finding)
+    fp_c = [
+        (a.attestation_1.serialize(), a.attestation_2.serialize())
+        for a in dc.drain_slashings()[0]
+    ]
+    fp_r = [
+        (a.attestation_1.serialize(), a.attestation_2.serialize())
+        for a in dr.drain_slashings()[0]
+    ]
+    assert fp_c == fp_r, "columnar vs scalar emission mismatch"
+
+    atts_per_sec = n_atts / t["median_s"]
+    ctrl_atts_per_sec = n_atts / ctrl_scaled
+    return {
+        "metric": "slasher_ingest",
+        "value": round(atts_per_sec, 1),
+        "unit": (
+            f"atts/sec (one epoch's {n_atts}-aggregate mainnet flood at "
+            f"{n_val} validators, seeded-slashing recall riding)"
+        ),
+        "vs_baseline": round(atts_per_sec / ctrl_atts_per_sec, 2),
+        "baseline_control": (
+            "retained scalar engine (slasher/reference.py) on a 1/16 "
+            "validator subsample (1/16 of the committees, same committee "
+            "size), same run, scaled x16"
+        ),
+        "config": {
+            "validators": n_val,
+            "aggregates": n_atts,
+            "committee_size": n_val // n_comm,
+            "cycle_ms": round(t["median_s"] * 1000, 1),
+            "validator_attestations_per_sec": round(n_val / t["median_s"]),
+            "scalar_scaled_ms": round(ctrl_scaled * 1000, 1),
+            "flood_build_s": round(build_s, 2),
+            "exact_scans": int(exact_scans),
+            "recall": recall,
+            "differential_check": "passed",
+        },
+        "stages": stages,
+        "spread": t,
+        "control_spread": {
+            "median_s": statistics.median(ctrl_times),
+            "min_s": min(ctrl_times),
+            "max_s": max(ctrl_times),
+            "trials": len(ctrl_times),
+        },
+    }
+
+
 _METRICS = {
     "merkle": bench_merkle,
     "pairing": bench_pairing,
@@ -1648,6 +1870,7 @@ _METRICS = {
     "attestation_batch": bench_attestation_batch,
     "fork_choice": bench_fork_choice,
     "op_pool": bench_op_pool,
+    "slasher_ingest": bench_slasher_ingest,
 }
 
 
@@ -1808,6 +2031,10 @@ def main():
         # 500k-attestation pool build (~20 s of insert-time hashing) + 3
         # flat packs + the 31k-candidate rescan reference controls
         "op_pool": 240,
+        # 2x 2048-aggregate flood build + 3 pre-warmed engines (one warm
+        # epoch each) + 3 timed flood cycles + 2 scalar-subsample
+        # controls; BENCH_TIMEOUT_SLASHER_INGEST overrides (0 = skip)
+        "slasher_ingest": 240,
     }
     for name, cap in secondary_caps.items():
         cap = _metric_cap(name, cap)

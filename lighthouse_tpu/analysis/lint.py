@@ -5,13 +5,16 @@ kept by convention only (the shape of the reference's `safe_arith` crate
 and milhouse `&mut` discipline, as a linter instead of a type system):
 
 * ``safe-arith`` — raw ``+ - * //`` on recognized uint64 state
-  quantities inside ``state_processing/`` must route through
-  `lighthouse_tpu/utils/safe_arith` (checked scalar helpers, wide-checked
-  vectorized helpers). Recognized quantities: ``*.effective_balance``
-  reads, ``state.balances[...]`` / ``state.slashings[...]`` /
-  ``state.inactivity_scores[...]`` subscripts, values produced by
-  ``load_balances()`` / ``load_inactivity_scores()`` / ``load_array()``,
-  and names assigned from any of those within the same function.
+  quantities inside ``state_processing/`` / ``fork_choice/`` /
+  ``slasher/`` must route through `lighthouse_tpu/utils/safe_arith`
+  (checked scalar helpers, wide-checked vectorized helpers). Recognized
+  quantities: ``*.effective_balance`` reads, ``state.balances[...]`` /
+  ``state.slashings[...]`` / ``state.inactivity_scores[...]`` and
+  proto-array ``_weights[...]`` / ``_balances[...]`` subscripts, values
+  produced by ``load_balances()`` / ``load_inactivity_scores()`` /
+  ``load_array()`` and the slasher span gathers ``gather_min()`` /
+  ``gather_max()``, and names assigned from any of those within the
+  same function.
 
 * ``cow-aliasing`` — arrays obtained from `PersistentList.load_array`,
   `CommitteeCache.committee_array`, or RegistryColumns / EpochArrays
@@ -82,7 +85,16 @@ _U64_SUBSCRIPT_BASES = {
     "_weights",
     "_balances",
 }
-_U64_PRODUCER_CALLS = {"load_balances", "load_inactivity_scores", "load_array"}
+_U64_PRODUCER_CALLS = {
+    "load_balances",
+    "load_inactivity_scores",
+    "load_array",
+    # the slasher's span gathers (slasher/spans.py) yield uint16 distance
+    # lanes; raw arithmetic on them wraps at the clamp ceiling exactly
+    # like the u64 columns — route through safe_arith or compare only
+    "gather_min",
+    "gather_max",
+}
 _RAW_OPS = (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv)
 _OP_GLYPH = {ast.Add: "+", ast.Sub: "-", ast.Mult: "*", ast.FloorDiv: "//"}
 
@@ -355,8 +367,14 @@ def _check_safe_arith(tree: ast.Module, path: str) -> list[Violation]:
     p = path.replace("\\", "/")
     # fork_choice joined the rule's scope with the columnar proto-array
     # (PR 12): its weight/balance columns are the same uint64 register the
-    # epoch sweeps use
-    if "state_processing" not in p and "fork_choice" not in p:
+    # epoch sweeps use. slasher/ joined with the columnar span subsystem
+    # (PR 13): span distances and epoch arithmetic are uint-lane
+    # quantities (the retained reference.py carries an allow-file).
+    if (
+        "state_processing" not in p
+        and "fork_choice" not in p
+        and "slasher" not in p
+    ):
         return []
     out: list[Violation] = []
     for _scope, body in _function_scopes(tree):

@@ -935,10 +935,11 @@ class BeaconChain:
                 ]
                 if accepted:
                     if self.slasher_service is not None:
-                        for _att, res in accepted:
-                            self.slasher_service.observe_indexed_attestation(
-                                res.indexed_attestation
-                            )
+                        # one call for the drained batch: the columnar
+                        # slasher consumes its queue as one array program
+                        self.slasher_service.observe_indexed_attestations(
+                            [res.indexed_attestation for _a, res in accepted]
+                        )
                     # one vectorized vote write per (head root, target
                     # epoch) group instead of a per-validator dict walk;
                     # fork-choice rejection of individual attestations is

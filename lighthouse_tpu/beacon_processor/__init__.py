@@ -54,6 +54,11 @@ class WorkType(enum.IntEnum):
     GOSSIP_PROPOSER_SLASHING = 11
     GOSSIP_ATTESTER_SLASHING = 12
     BACKFILL_SYNC = 13
+    #: slasher epoch detection (slasher/service): the whole cycle is
+    #: deferrable background work — lowest priority, so a storm drains
+    #: every protocol lane before detection takes a worker, and detection
+    #: NEVER runs inline on a gossip reader thread (queue-discipline)
+    SLASHER_PROCESS = 14
 
 
 _QUEUE_BOUNDS = {
@@ -71,6 +76,9 @@ _QUEUE_BOUNDS = {
     WorkType.GOSSIP_PROPOSER_SLASHING: 512,
     WorkType.GOSSIP_ATTESTER_SLASHING: 512,
     WorkType.BACKFILL_SYNC: 64,
+    # one epoch tick per slot; a tiny bound surfaces a stalled worker
+    # pool as drop-counted backpressure instead of a silent backlog
+    WorkType.SLASHER_PROCESS: 4,
 }
 
 _BATCHED = {

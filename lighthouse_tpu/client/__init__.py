@@ -100,7 +100,14 @@ class Client:
             # pre-build next slot's state off the (possibly new) head
             self.state_advance.on_slot_tick(slot)
         if self.chain.slasher_service is not None:
-            self.chain.slasher_service.on_slot(slot)
+            # detection rides the network's SLASHER_PROCESS lane when the
+            # node networks (lowest priority, worker thread); inline only
+            # on network-less nodes. The service's epoch claim dedups
+            # against the network slot tick firing for the same epoch.
+            processor = (
+                self.network.processor if self.network is not None else None
+            )
+            self.chain.slasher_service.on_slot(slot, processor=processor)
         set_gauge("beacon_head_slot", self.chain.head_state.slot)
 
     def stop(self):

@@ -40,6 +40,7 @@ ROOT_SPAN_NAMES = (
     "sync_range_batch",
     "api_request",
     "fork_choice_get_head",
+    "slasher_process",
 )
 
 _RING_SIZE = int(os.environ.get("LIGHTHOUSE_TPU_TRACE_RING", "256"))

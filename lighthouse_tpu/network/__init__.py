@@ -823,6 +823,12 @@ class NetworkService:
         self._last_tick_slot = slot
         self.reprocess.slot_started(slot, self.processor)
         self.reprocess.expire(slot)
+        # slasher epoch detection rides its own lowest-priority processor
+        # lane (WorkType.SLASHER_PROCESS) — queued here, never run on this
+        # heartbeat thread; the service's epoch claim keeps this and the
+        # client slot timer from double-processing
+        if self.chain.slasher_service is not None:
+            self.chain.slasher_service.on_slot(slot, processor=self.processor)
 
     def discover_and_connect(self, max_peers: int = 8) -> int:
         """One discovery round → dial every new connectable record

@@ -29,6 +29,10 @@ class DBColumn(str, Enum):
     SLASHER_ATTESTATION = "sat"
     SLASHER_INDEXED = "sai"
     SLASHER_BLOCK = "sbk"
+    # chunked min/max-span tiles (slasher/spans.py): key = epoch_chunk
+    # (8B BE) || validator_chunk (8B BE), value = uint16-LE tile
+    SLASHER_MIN_SPAN = "smn"
+    SLASHER_MAX_SPAN = "smx"
 
 
 class ItemStore:

@@ -54,6 +54,7 @@ def pytest_sessionstart(session):
         attestation_batch,  # the batch path counter + attestation_apply span
         registry_columns,  # the columns counters + epoch_stage spans
     )
+    import lighthouse_tpu.slasher  # noqa: F401 — registers slasher_* series
 
     text = REGISTRY.expose()
     for needle in (
@@ -199,6 +200,30 @@ def pytest_sessionstart(session):
         "trace_span_seconds_delta_compute",
         "trace_span_seconds_weight_roll",
         "trace_span_seconds_best_child",
+        # PR 13: columnar slasher — engine/scan/tile counters, the
+        # slasher_process trace root and its stage spans, and the
+        # SLASHER_PROCESS processor lane series must exist at zero (the
+        # slasher_ingest bench reads counter deltas + stage spans eagerly)
+        "slasher_attester_slashings_found",
+        "slasher_proposer_slashings_found",
+        'slasher_slashings_found_total{kind="attester"}',
+        'slasher_slashings_found_total{kind="proposer"}',
+        'slasher_process_cycles_total{engine="columnar"}',
+        'slasher_process_cycles_total{engine="reference"}',
+        "slasher_attestations_processed_total",
+        "slasher_exact_scans_total",
+        "slasher_span_tiles_flushed_total",
+        "slasher_span_rebuilds_total",
+        'trace_collector_traces_total{root="slasher_process"}',
+        'profiler_samples_total{root="slasher_process"}',
+        "trace_span_seconds_slasher_process",
+        "trace_span_seconds_span_gather",
+        "trace_span_seconds_span_compare",
+        "trace_span_seconds_span_update",
+        "trace_span_seconds_persist",
+        "beacon_processor_queue_wait_seconds_slasher_process",
+        "beacon_processor_work_seconds_slasher_process",
+        'beacon_processor_abandoned_total{kind="slasher_process"}',
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"

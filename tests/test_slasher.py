@@ -1,6 +1,10 @@
 """Slasher detection: double votes, surround votes, double proposals,
 pruning — and the produced slashings actually apply in the state
-transition (slasher/src/slasher.rs test surface)."""
+transition (slasher/src/slasher.rs test surface).
+
+Every engine-generic test runs against BOTH engines: the columnar
+min/max-span subsystem (default) and the retained scalar reference
+(`slasher/reference.py`) — same detections, same emission order."""
 
 from dataclasses import replace
 
@@ -8,10 +12,19 @@ import pytest
 
 from lighthouse_tpu.crypto import bls
 from lighthouse_tpu.slasher import Slasher, SlasherConfig
+from lighthouse_tpu.slasher.columnar import ColumnarSlasher
+from lighthouse_tpu.slasher.reference import ReferenceSlasher
 from lighthouse_tpu.types.containers import build_types
 from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
 
 T = build_types(E)
+
+ENGINES = {"columnar": ColumnarSlasher, "reference": ReferenceSlasher}
+
+
+@pytest.fixture(params=sorted(ENGINES))
+def engine(request):
+    return ENGINES[request.param]
 
 
 def _att(indices, source, target, root=b"\x01" * 32, head=b"\x02" * 32):
@@ -41,8 +54,8 @@ def _header(proposer, slot, state_root=b"\x00" * 32):
     )
 
 
-def test_double_vote_detected():
-    s = Slasher(E)
+def test_double_vote_detected(engine):
+    s = engine(E)
     s.accept_attestation(_att([1, 2], 0, 5, head=b"\x02" * 32))
     s.accept_attestation(_att([2, 3], 0, 5, head=b"\x03" * 32))  # same target, diff data
     out = s.process_queued(current_epoch=6)
@@ -54,8 +67,8 @@ def test_double_vote_detected():
     assert sl.attestation_1.data.hash_tree_root() != sl.attestation_2.data.hash_tree_root()
 
 
-def test_duplicate_attestation_not_slashable():
-    s = Slasher(E)
+def test_duplicate_attestation_not_slashable(engine):
+    s = engine(E)
     a = _att([1], 0, 5)
     s.accept_attestation(a)
     s.accept_attestation(_att([1], 0, 5))  # identical data
@@ -63,12 +76,12 @@ def test_duplicate_attestation_not_slashable():
     assert out["attester_slashings"] == 0
 
 
-def test_surround_both_directions():
+def test_surround_both_directions(engine):
     from lighthouse_tpu.state_processing.accessors import (
         is_slashable_attestation_data,
     )
 
-    s = Slasher(E)
+    s = engine(E)
     s.accept_attestation(_att([7], 2, 3))
     s.process_queued(4)
     # new surrounds old: (1, 5) ⊃ (2, 3)
@@ -78,7 +91,7 @@ def test_surround_both_directions():
     # emitted order must satisfy the spec predicate (data_1 surrounds data_2)
     assert is_slashable_attestation_data(sl[0].attestation_1.data, sl[0].attestation_2.data)
 
-    s2 = Slasher(E)
+    s2 = engine(E)
     s2.accept_attestation(_att([9], 1, 6))
     s2.process_queued(7)
     # old surrounds new: (2, 4) ⊂ (1, 6)
@@ -90,8 +103,8 @@ def test_surround_both_directions():
     )
 
 
-def test_double_proposal_detected():
-    s = Slasher(E)
+def test_double_proposal_detected(engine):
+    s = engine(E)
     s.accept_block_header(_header(4, 32, state_root=b"\xaa" * 32))
     s.accept_block_header(_header(4, 32, state_root=b"\xbb" * 32))
     s.accept_block_header(_header(4, 33, state_root=b"\xcc" * 32))  # different slot ok
@@ -101,16 +114,41 @@ def test_double_proposal_detected():
     assert props[0].signed_header_1.message.slot == 32
 
 
-def test_pruning_bounds_history():
-    s = Slasher(E, SlasherConfig(history_length=4))
+def test_double_proposal_not_reemitted_on_relay(engine):
+    """Regression: the same equivocating header pair is re-gossiped by
+    every peer; a re-seen pair must not re-emit another ProposerSlashing
+    (one emission per equivocation, dedup keyed (proposer, slot, roots))."""
+    s = engine(E)
+    h1 = _header(4, 32, state_root=b"\xaa" * 32)
+    h2 = _header(4, 32, state_root=b"\xbb" * 32)
+    s.accept_block_header(h1)
+    s.accept_block_header(h2)
+    assert s.process_queued(5)["proposer_slashings"] == 1
+    # the pair re-arrives (relay storm), same cycle AND a later cycle
+    s.accept_block_header(h1)
+    s.accept_block_header(h2)
+    s.accept_block_header(h2)
+    assert s.process_queued(5)["proposer_slashings"] == 0
+    s.accept_block_header(h2)
+    assert s.process_queued(6)["proposer_slashings"] == 0
+    _, props = s.drain_slashings()
+    assert len(props) == 1
+    # a THIRD conflicting header is a new pair: emitted once
+    s.accept_block_header(_header(4, 32, state_root=b"\xcc" * 32))
+    assert s.process_queued(6)["proposer_slashings"] == 1
+
+
+def test_pruning_bounds_history(engine):
+    s = engine(E, SlasherConfig(history_length=4))
     s.accept_attestation(_att([1], 0, 1))
     s.process_queued(1)
-    assert 1 in s._atts
+    assert s.has_attestation_record(1, 1)
     s.process_queued(100)  # far future: epoch-1 record pruned
-    assert 1 not in s._atts
+    assert not s.has_attestation_record(1, 1)
+    assert s.attestation_record_count() == 0
 
 
-def test_detected_slashing_applies_in_state_transition():
+def test_detected_slashing_applies_in_state_transition(engine):
     """End-to-end: the slasher's output feeds process_attester_slashing and
     the offender gets slashed (the slasher/service → op-pool → block path)."""
     from lighthouse_tpu.state_processing import interop_genesis_state
@@ -123,7 +161,7 @@ def test_detected_slashing_applies_in_state_transition():
     state = interop_genesis_state(kps, 1_600_000_000, b"\x42" * 32, spec, E)
     state.slot = 6 * E.SLOTS_PER_EPOCH
 
-    s = Slasher(E)
+    s = engine(E)
     s.accept_attestation(_att([3], 0, 5, head=b"\x02" * 32))
     s.accept_attestation(_att([3], 0, 5, head=b"\x03" * 32))
     s.process_queued(6)
@@ -168,7 +206,17 @@ def test_slasher_service_end_to_end():
     assert slashed == {3}
 
 
-def test_persistence_restart_detects_double_vote(tmp_path):
+def test_columnar_kill_switch(monkeypatch):
+    """LIGHTHOUSE_TPU_COLUMNAR_SLASHER=0 routes the factory to the
+    retained scalar engine; default is the columnar subsystem."""
+    assert isinstance(Slasher(E), ColumnarSlasher)
+    monkeypatch.setenv("LIGHTHOUSE_TPU_COLUMNAR_SLASHER", "0")
+    assert isinstance(Slasher(E), ReferenceSlasher)
+    monkeypatch.setenv("LIGHTHOUSE_TPU_COLUMNAR_SLASHER", "1")
+    assert isinstance(Slasher(E), ColumnarSlasher)
+
+
+def test_persistence_restart_detects_double_vote(engine, tmp_path):
     """Detection history written through the KV store survives a restart:
     the first vote lands before the 'crash', the conflicting one after."""
     from lighthouse_tpu.store import open_item_store
@@ -176,7 +224,7 @@ def test_persistence_restart_detects_double_vote(tmp_path):
     from lighthouse_tpu.store.kv import DBColumn
 
     store = open_item_store(str(tmp_path / "slasher-db"))
-    s1 = Slasher(E, store=store)
+    s1 = engine(E, store=store)
     s1.accept_attestation(_att([7, 8], 0, 5, head=b"\x02" * 32))
     s1.accept_block_header(_header(3, 41))
     assert s1.process_queued(current_epoch=6) == {
@@ -188,9 +236,9 @@ def test_persistence_restart_detects_double_vote(tmp_path):
     assert len(store.keys(DBColumn.SLASHER_ATTESTATION)) == 2
     del s1  # no clean shutdown needed — process_queued already flushed
 
-    s2 = Slasher(E, store=store)
+    s2 = engine(E, store=store)
     # records reloaded
-    assert 7 in s2._atts and 5 in s2._atts[7]
+    assert s2.has_attestation_record(7, 5) and s2.has_attestation_record(8, 5)
     assert 3 in s2._blocks and 41 in s2._blocks[3]
     # conflicting vote and proposal arriving after restart still slash
     s2.accept_attestation(_att([8], 0, 5, head=b"\x03" * 32))
@@ -201,12 +249,12 @@ def test_persistence_restart_detects_double_vote(tmp_path):
     store.close()
 
 
-def test_persistence_prunes_on_disk(tmp_path):
+def test_persistence_prunes_on_disk(engine, tmp_path):
     from lighthouse_tpu.store import open_item_store
     from lighthouse_tpu.store.kv import DBColumn
 
     store = open_item_store(str(tmp_path / "slasher-db"))
-    s = Slasher(E, SlasherConfig(history_length=4), store=store)
+    s = engine(E, SlasherConfig(history_length=4), store=store)
     s.accept_attestation(_att([1], 0, 2))
     s.process_queued(current_epoch=3)
     assert store.keys(DBColumn.SLASHER_ATTESTATION)
@@ -214,6 +262,6 @@ def test_persistence_prunes_on_disk(tmp_path):
     assert store.keys(DBColumn.SLASHER_ATTESTATION) == []
     assert store.keys(DBColumn.SLASHER_INDEXED) == []
     # a fresh instance sees the pruned view
-    s2 = Slasher(E, SlasherConfig(history_length=4), store=store)
-    assert s2._atts == {}
+    s2 = engine(E, SlasherConfig(history_length=4), store=store)
+    assert s2.attestation_record_count() == 0
     store.close()

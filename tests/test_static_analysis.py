@@ -110,6 +110,39 @@ def test_safe_arith_clean_fork_choice_routed_through_vector_helpers():
     assert lint_source(good, FC) == []
 
 
+# a synthetic path inside slasher/ — in the safe-arith scope since the
+# columnar span subsystem (PR 13: span distances are clamped uint16
+# lanes, epoch windows are uint arithmetic)
+SL = "lighthouse_tpu/slasher/_fixture.py"
+
+
+def test_safe_arith_fires_on_slasher_span_gathers():
+    bad = (
+        "def f(self, spans, idx, epoch):\n"
+        "    mins = spans.gather_min(idx, epoch)\n"
+        "    return mins - 1\n"
+    )
+    assert _rules(lint_source(bad, SL)) == ["safe-arith"]
+
+
+def test_safe_arith_slasher_clean_when_comparing_only():
+    good = (
+        "def f(self, spans, idx, epoch, d):\n"
+        "    mins = spans.gather_min(idx, epoch)\n"
+        "    maxs = spans.gather_max(idx, epoch)\n"
+        "    return (mins < d) | (maxs > d)\n"
+    )
+    assert lint_source(good, SL) == []
+
+
+def test_safe_arith_span_gathers_scoped_to_slasher():
+    outside = (
+        "def f(self, spans, idx, epoch):\n"
+        "    return spans.gather_min(idx, epoch) - 1\n"
+    )
+    assert lint_source(outside, OUT) == []
+
+
 def test_cow_aliasing_fires_on_attesting_index_view_write_in_fork_choice():
     # the batch entry reads attesting_indices.load_array() — a frozen
     # CoW view; writing it must fire regardless of the module's path
