@@ -86,6 +86,11 @@ class EventSubscription:
 class ServerSentEventHandler:
     def __init__(self):
         self._subs: list[EventSubscription] = []
+        # in-process synchronous consumers (the http_api response cache's
+        # head-change invalidation, the /headers block-listing eviction):
+        # unlike subscriptions there is no queue to poll — the chain's
+        # publishing thread calls them inline, so they must be cheap
+        self._listeners: list[tuple[frozenset, object]] = []
         self._lock = threading.Lock()
 
     def subscribe(self, topics=ALL_TOPICS) -> EventSubscription:
@@ -102,13 +107,42 @@ class ServerSentEventHandler:
             if sub in self._subs:
                 self._subs.remove(sub)
 
+    def add_listener(self, topics, fn):
+        """Register a synchronous in-process listener `fn(topic, data)`
+        for a set of topics. Listener faults are contained (logged, never
+        propagated into the chain's import path)."""
+        bad = set(topics) - set(ALL_TOPICS)
+        if bad:
+            raise ValueError(f"unknown event topics: {sorted(bad)}")
+        with self._lock:
+            self._listeners.append((frozenset(topics), fn))
+
+    def remove_listener(self, fn):
+        # equality, not identity: every `self.method` access mints a new
+        # bound-method object, but equal ones compare ==
+        with self._lock:
+            self._listeners = [
+                (t, f) for (t, f) in self._listeners if f != fn
+            ]
+
     def _publish(self, topic: str, data: dict):
         ev = {"topic": topic, "data": data}
         with self._lock:
             subs = list(self._subs)
+            listeners = list(self._listeners)
         for s in subs:
             if topic in s.topics:
                 s._offer(ev)
+        for topics, fn in listeners:
+            if topic in topics:
+                try:
+                    fn(topic, data)
+                except Exception:  # noqa: BLE001 — listener faults stay local
+                    from ..utils.logging import get_logger
+
+                    get_logger("lighthouse_tpu.events").exception(
+                        "event listener failed (topic=%s)", topic
+                    )
 
     # -- chain-facing emitters (events.rs register_* methods) -----------
 

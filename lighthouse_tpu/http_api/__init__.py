@@ -9,7 +9,15 @@ analog), plus the /metrics exposition of http_metrics (272 LoC crate).
 
 Every uint64 is a JSON string and keys are snake_case per the API spec;
 roots are 0x-hex. SSZ (`Accept: application/octet-stream`) is honored on
-the block/state endpoints."""
+the block/state/validator_balances endpoints.
+
+The read-heavy routes (validators / balances / committees / headers)
+are a SERVING TIER (PR 14): response bytes assembled zero-copy from the
+resident RegistryColumns (`columnar.py`), cached per route keyed on
+(head root, normalized query) with head-event invalidation
+(`response_cache.py`), and headers/blocks indexed by root and slot
+(`block_index.py`). The dict-returning per-object methods are retained
+as byte-identical differential oracles."""
 
 from __future__ import annotations
 
@@ -27,6 +35,19 @@ from ..state_processing.accessors import (
     compute_start_slot_at_epoch,
     get_beacon_proposer_index,
 )
+from . import columnar
+from .block_index import BlockHeaderIndex
+from .columnar import QueryError, validator_status
+from .response_cache import ResponseCache
+
+#: every JSON body uses the compact separators — the columnar assembler
+#: emits them directly, so the per-object oracle must serialize the same
+#: way for the byte-identical differential to hold
+_JSON_SEPARATORS = (",", ":")
+
+
+def _dump_json(obj) -> bytes:
+    return json.dumps(obj, separators=_JSON_SEPARATORS).encode()
 
 
 def _hex(b: bytes) -> str:
@@ -51,11 +72,11 @@ def _container_json(value):
     return value
 
 
-def _validator_json(i: int, v, balance: int) -> dict:
+def _validator_json(i: int, v, balance: int, status: str) -> dict:
     return {
         "index": str(i),
         "balance": str(balance),
-        "status": "active_ongoing",
+        "status": status,
         "validator": {
             "pubkey": _hex(v.pubkey),
             "withdrawal_credentials": _hex(v.withdrawal_credentials),
@@ -87,39 +108,97 @@ class BeaconApi:
         # snapshot cache (the API may be constructed after finality)
         self._genesis_time = int(chain.head_state.genesis_time)
         self._genesis_validators_root = bytes(chain.genesis_validators_root)
+        # the read-serving tier: per-route response caches keyed on
+        # (head root, normalized query) + block-root-indexed header
+        # lookups; the fork-choice head event (the one the SSE stream
+        # consumes) invalidates, the block event keeps /headers honest
+        # about fork blocks that don't move the head
+        self.response_cache = ResponseCache()
+        self.block_index = BlockHeaderIndex(chain)
+        from ..beacon_chain.events import TOPIC_BLOCK, TOPIC_HEAD
+
+        chain.event_handler.add_listener((TOPIC_HEAD,), self._on_head_event)
+        chain.event_handler.add_listener((TOPIC_BLOCK,), self._on_block_event)
+
+    def _on_head_event(self, _topic: str, data: dict):
+        # entries for the new head, genesis, and the finalized root stay
+        # (still valid AND still hot — a client polling /states/finalized
+        # must not reassemble every slot); everything else is dead weight
+        keep = {bytes.fromhex(data["block"][2:]), self.chain.genesis_block_root}
+        cp = getattr(self.chain, "finalized_checkpoint", None)
+        if cp is not None:
+            keep.add(bytes(cp.root))
+        self.response_cache.on_head_change(keep)
+
+    def _on_block_event(self, _topic: str, _data: dict):
+        self.response_cache.evict_route("headers")
+
+    def close(self):
+        """Detach from the chain's event handler (server shutdown — a
+        replaced BeaconApi must not keep invalidating forever)."""
+        self.chain.event_handler.remove_listener(self._on_head_event)
+        self.chain.event_handler.remove_listener(self._on_block_event)
 
     # -- state resolution ----------------------------------------------------
 
-    def _state(self, state_id: str):
+    def _resolve_state(self, state_id: str):
+        """(cache key root, state) for a StateId. The key root pins the
+        response cache: a body derived from an immutable state never goes
+        stale under its own (root, query) key."""
         chain = self.chain
         if state_id == "head":
-            return chain.head_state
+            # read the root ONCE and resolve the state through it — a
+            # concurrent head move between two reads would otherwise pair
+            # the old root with the new state and poison the cache key
+            root = chain.head_root
+            st = chain._states.get(root)
+            if st is None:
+                root = chain.head_root
+                st = chain.head_state
+            return root, st
         if state_id == "genesis":
             st = chain._states.get(chain.genesis_block_root)
             if st is None:
                 raise ApiError(
                     404, "genesis state pruned from the hot cache"
                 )
-            return st
+            return chain.genesis_block_root, st
         if state_id == "finalized":
             cp = chain.finalized_checkpoint
             st = chain._justified_state_provider(cp.root)
             if st is None:
                 raise ApiError(404, "finalized state unavailable")
-            return st
+            return bytes(cp.root), st
         if state_id.startswith("0x"):
-            root = bytes.fromhex(state_id[2:])
+            try:
+                root = bytes.fromhex(state_id[2:])
+            except ValueError as e:
+                raise ApiError(400, f"invalid state id {state_id}") from e
             st = chain.store.get_state(root)
             if st is None:
                 raise ApiError(404, f"state {state_id} not found")
-            return st
+            return root, st
         if state_id.isdigit():
             slot = int(state_id)
             st = chain.head_state
             if st.slot == slot:
-                return st
+                return chain.head_root, st
             raise ApiError(404, f"state at slot {slot} not in cache")
         raise ApiError(400, f"invalid state id {state_id}")
+
+    def _state(self, state_id: str):
+        return self._resolve_state(state_id)[1]
+
+    def _columns_for(self, st):
+        """The state's refreshed resident columns, or None when the
+        state isn't in the tree-states representation (the per-object
+        oracle path serves it instead)."""
+        from ..state_processing.registry_columns import registry_columns_for
+
+        cols = registry_columns_for(st)
+        if cols is None or not cols.try_refresh(st):
+            return None
+        return cols
 
     def _block(self, block_id: str):
         chain = self.chain
@@ -130,16 +209,17 @@ class BeaconApi:
             return chain.head_root, b
         if block_id.startswith("0x"):
             root = bytes.fromhex(block_id[2:])
-            b = chain._blocks_by_root.get(root) or chain.store.get_block(root)
+            # hot set → bounded store-load LRU → ONE store deserialization
+            b = self.block_index.block(root)
             if b is None:
                 raise ApiError(404, f"block {block_id} not found")
             return root, b
         if block_id.isdigit():
             slot = int(block_id)
-            for root, b in chain._blocks_by_root.items():
-                if b.message.slot == slot:
-                    return root, b
-            raise ApiError(404, f"block at slot {slot} not found")
+            roots = self.block_index.roots_at_slot(slot)
+            if not roots:
+                raise ApiError(404, f"block at slot {slot} not found")
+            return roots[0], self.block_index.block(roots[0])
         raise ApiError(400, f"invalid block id {block_id}")
 
     # -- node ----------------------------------------------------------------
@@ -251,44 +331,308 @@ class BeaconApi:
             }
         }
 
-    def state_validators(self, state_id: str, indices=None):
-        st = self._state(state_id)
-        out = []
-        for i, v in enumerate(st.validators):
-            if indices and i not in indices and _hex(v.pubkey) not in indices:
-                continue
-            out.append(_validator_json(i, v, st.balances[i]))
-        return {"data": out, "execution_optimistic": False, "finalized": False}
+    # -- validators: the columnar serving tier -------------------------------
+    #
+    # `serve_*` methods build final response BYTES zero-copy from the
+    # resident RegistryColumns through the per-route response cache (the
+    # HTTP layer sends them verbatim). The dict-returning methods below
+    # them are the RETAINED PER-OBJECT ORACLES: same shapes, same fixed
+    # statuses, used by the differential suite and the bench control —
+    # never on the hot path.
+
+    def _parse_validator_query(self, st, cols, query):
+        """Normalize a validators/balances request WITHOUT touching any
+        full-table column: ids resolved once, statuses/pagination parsed
+        into the cache-key form. Row selection (which may need a
+        full-table status pass) happens only after a cache MISS."""
+        query = query or {}
+        n = len(st.balances)
+        try:
+            ids = query.get("id")
+            id_idx = None
+            if ids:
+                if cols is not None:
+                    resolver = lambda pk: cols.pubkey_index().get(pk)  # noqa: E731
+                else:
+                    # lazy: the O(n) oracle dict is built only if some
+                    # id actually IS a pubkey (numeric-only filters on a
+                    # column-less state stay O(k))
+                    memo: list = []
+
+                    def resolver(pk, _st=st, _memo=memo):
+                        if not _memo:
+                            _memo.append(self._oracle_pubkey_resolver(_st))
+                        return _memo[0](pk)
+
+                id_idx = columnar.normalize_ids(ids, resolver, n)
+            statuses = query.get("status")
+            status_filter = (
+                columnar.normalize_statuses(statuses) if statuses else None
+            )
+            limit, offset = columnar.parse_pagination(query)
+        except QueryError as e:
+            raise ApiError(400, str(e)) from e
+        qnorm = "&".join(
+            p
+            for p in (
+                f"id={','.join(map(str, id_idx.tolist()))}"
+                if id_idx is not None
+                else "",
+                f"status={','.join(map(str, sorted(status_filter)))}"
+                if status_filter
+                else "",
+                f"limit={limit}" if limit is not None else "",
+                f"offset={offset}" if offset else "",
+            )
+            if p
+        )
+        cacheable = id_idx is None  # id-filtered bodies churn per-VC
+        return qnorm, id_idx, status_filter, limit, offset, cacheable
+
+    def _select_validator_rows(self, st, cols, id_idx, status_filter,
+                               limit, offset):
+        """The post-miss row selection: full-table status codes are
+        computed only when a status filter demands them — vectorized
+        over the columns, or per-object when the state has none (the
+        oracle path must filter too, not crash)."""
+        n = len(st.balances)
+        codes = None
+        if status_filter is not None:
+            cur = compute_epoch_at_slot(int(st.slot), self.chain.E)
+            if cols is not None:
+                codes = columnar.status_codes(
+                    cols.activation_eligibility_epoch,
+                    cols.activation_epoch,
+                    cols.exit_epoch,
+                    cols.withdrawable_epoch,
+                    cols.slashed,
+                    cols.balances,
+                    cur,
+                )
+            else:
+                import numpy as _np
+
+                codes = _np.fromiter(
+                    (
+                        columnar.STATUSES.index(
+                            validator_status(
+                                int(v.activation_eligibility_epoch),
+                                int(v.activation_epoch),
+                                int(v.exit_epoch),
+                                int(v.withdrawable_epoch),
+                                bool(v.slashed),
+                                int(st.balances[i]),
+                                cur,
+                            )
+                        )
+                        for i, v in enumerate(st.validators)
+                    ),
+                    dtype=_np.uint8,
+                    count=n,
+                )
+        idx = columnar.select_rows(
+            n, id_idx, status_filter, codes, limit, offset
+        )
+        return idx, codes
+
+    def _oracle_pubkey_resolver(self, st):
+        by_pk = {}
+        for i in range(len(st.validators) - 1, -1, -1):
+            by_pk[bytes(st.validators[i].pubkey)] = i
+        return by_pk.get
+
+    def _serve_cached(self, route, state_id, query, build, qnorm_suffix=""):
+        """The shared cache-then-assemble path: cache_lookup / assemble /
+        serialize trace stages under the api_request root. A cache hit
+        pays only id/pagination normalization — never a full-table
+        column pass."""
+        root, st = self._resolve_state(state_id)
+        cols = self._columns_for(st)
+        qnorm, id_idx, status_filter, limit, offset, cacheable = (
+            self._parse_validator_query(st, cols, query)
+        )
+        qnorm += qnorm_suffix
+        with span("cache_lookup", route=route):
+            hit = (
+                self.response_cache.get(route, root, qnorm)
+                if cacheable
+                else None
+            )
+        if hit is not None:
+            return hit
+        idx, codes = self._select_validator_rows(
+            st, cols, id_idx, status_filter, limit, offset
+        )
+        body, content_type = build(st, cols, idx, codes)
+        if cacheable:
+            self.response_cache.put(route, root, qnorm, body, content_type)
+        return body, content_type
+
+    def serve_state_validators(self, state_id: str, query=None):
+        """GET /states/{id}/validators → (body bytes, content type),
+        assembled zero-copy from the columns (per-object oracle fallback
+        when the state has no resident columns)."""
+
+        def build(st, cols, idx, codes):
+            if cols is None:
+                with span("assemble", route="validators"):
+                    doc = self.state_validators_reference(
+                        st, None if idx is None else idx.tolist()
+                    )
+                with span("serialize", route="validators"):
+                    return _dump_json(doc), "application/json"
+            body = columnar.assemble_validators(
+                cols,
+                cols.balances,
+                idx,
+                compute_epoch_at_slot(int(st.slot), self.chain.E),
+                codes,
+            )
+            columnar.count_assembled("validators")
+            return body, "application/json"
+
+        return self._serve_cached("validators", state_id, query, build)
+
+    def serve_state_validator_balances(self, state_id: str, query=None,
+                                       ssz: bool = False):
+        """GET /states/{id}/validator_balances → (body, content type).
+        The SSZ variant (Accept: application/octet-stream) is fixed
+        16-byte (index, balance) rows — one interleave, zero per-row
+        Python."""
+
+        def build(st, cols, idx, codes):
+            if cols is None:
+                with span("assemble", route="validator_balances"):
+                    rows = (
+                        range(len(st.balances))
+                        if idx is None
+                        else idx.tolist()
+                    )
+                    if ssz:
+                        body = b"".join(
+                            int(i).to_bytes(8, "little")
+                            + int(st.balances[i]).to_bytes(8, "little")
+                            for i in rows
+                        )
+                        return body, "application/octet-stream"
+                    doc = self.state_validator_balances_reference(
+                        st, None if idx is None else idx.tolist()
+                    )
+                with span("serialize", route="validator_balances"):
+                    return _dump_json(doc), "application/json"
+            if ssz:
+                with span("assemble", route="validator_balances"):
+                    body = columnar.balances_ssz(cols.balances, idx)
+                columnar.count_assembled("validator_balances")
+                return body, "application/octet-stream"
+            body = columnar.assemble_balances(cols.balances, idx)
+            columnar.count_assembled("validator_balances")
+            return body, "application/json"
+
+        return self._serve_cached(
+            "validator_balances", state_id, query, build,
+            qnorm_suffix="&ssz=1" if ssz else "",
+        )
 
     def state_validator(self, state_id: str, validator_id: str):
-        """GET /states/{id}/validators/{validator_id} (index or pubkey)."""
+        """GET /states/{id}/validators/{validator_id} (index or pubkey):
+        a single-row column gather — by-pubkey resolves through the
+        columns' pubkey→index map instead of the seed's O(n) scan."""
         st = self._state(state_id)
+        cols = self._columns_for(st)
+        n = len(st.balances)
         if validator_id.isdigit():
             i = int(validator_id)
-            if i >= len(st.validators):
+            if i >= n:
                 raise ApiError(404, "validator index out of range")
         else:
-            want = validator_id.lower()
-            for i, v in enumerate(st.validators):
-                if _hex(v.pubkey) == want:
-                    break
+            try:
+                pk = columnar._parse_pubkey(validator_id.lower())
+            except QueryError as e:
+                raise ApiError(400, str(e)) from e
+            if cols is not None:
+                got = cols.pubkey_index().get(pk)
             else:
+                got = self._oracle_pubkey_resolver(st)(pk)
+            if got is None:
                 raise ApiError(404, "unknown validator pubkey")
+            i = int(got)
+        cur = compute_epoch_at_slot(int(st.slot), self.chain.E)
+        v = st.validators[i]
+        status = validator_status(
+            int(v.activation_eligibility_epoch),
+            int(v.activation_epoch),
+            int(v.exit_epoch),
+            int(v.withdrawable_epoch),
+            bool(v.slashed),
+            int(st.balances[i]),
+            cur,
+        )
         return {
-            "data": _validator_json(i, st.validators[i], st.balances[i]),
+            "data": _validator_json(i, v, int(st.balances[i]), status),
             "execution_optimistic": False,
             "finalized": False,
         }
 
-    def state_validator_balances(self, state_id: str, indices=None):
-        """GET /states/{id}/validator_balances."""
-        st = self._state(state_id)
+    # -- per-object oracles (differential baselines + bench controls) --------
+
+    def state_validators_reference(self, st, indices=None):
+        """The retained per-validator object walk (spec shapes, real
+        statuses). `indices` is a pre-normalized int list or None."""
+        cur = compute_epoch_at_slot(int(st.slot), self.chain.E)
+        wanted = None if indices is None else set(indices)
         out = []
         for i, v in enumerate(st.validators):
-            if indices and i not in indices and _hex(v.pubkey) not in indices:
+            if wanted is not None and i not in wanted:
+                continue
+            bal = int(st.balances[i])
+            out.append(
+                _validator_json(
+                    i,
+                    v,
+                    bal,
+                    validator_status(
+                        int(v.activation_eligibility_epoch),
+                        int(v.activation_epoch),
+                        int(v.exit_epoch),
+                        int(v.withdrawable_epoch),
+                        bool(v.slashed),
+                        bal,
+                        cur,
+                    ),
+                )
+            )
+        return {"data": out, "execution_optimistic": False, "finalized": False}
+
+    def state_validators(self, state_id: str, indices=None):
+        """Oracle entry by state id (ids normalized like the request
+        path: ints, digit strings, or 0x-pubkeys)."""
+        st = self._state(state_id)
+        idx = None
+        if indices:
+            idx = columnar.normalize_ids(
+                indices, self._oracle_pubkey_resolver(st), len(st.balances)
+            ).tolist()
+        return self.state_validators_reference(st, idx)
+
+    def state_validator_balances_reference(self, st, indices=None):
+        wanted = None if indices is None else set(indices)
+        out = []
+        for i in range(len(st.balances)):
+            if wanted is not None and i not in wanted:
                 continue
             out.append({"index": str(i), "balance": str(int(st.balances[i]))})
         return {"data": out, "execution_optimistic": False, "finalized": False}
+
+    def state_validator_balances(self, state_id: str, indices=None):
+        """GET /states/{id}/validator_balances (oracle entry)."""
+        st = self._state(state_id)
+        idx = None
+        if indices:
+            idx = columnar.normalize_ids(
+                indices, self._oracle_pubkey_resolver(st), len(st.balances)
+            ).tolist()
+        return self.state_validator_balances_reference(st, idx)
 
     def state_randao(self, state_id: str, epoch=None):
         """GET /states/{id}/randao. Epochs outside the stored historical
@@ -483,22 +827,19 @@ class BeaconApi:
         }
 
     def block_header(self, block_id: str):
-        root, signed = self._block(block_id)
-        m = signed.message
+        root, _signed = self._block(block_id)
+        # precomputed in the block index: the body root is hashed once
+        # per block, not once per request
+        entry = self.block_index.header_entry(root)
+        if entry is None:
+            raise ApiError(404, f"block {block_id} not found")
         return {
             "data": {
                 "root": _hex(root),
-                "canonical": True,
-                "header": {
-                    "message": {
-                        "slot": str(m.slot),
-                        "proposer_index": str(m.proposer_index),
-                        "parent_root": _hex(m.parent_root),
-                        "state_root": _hex(m.state_root),
-                        "body_root": _hex(m.body.hash_tree_root()),
-                    },
-                    "signature": _hex(signed.signature),
-                },
+                "canonical": self._is_canonical(
+                    root, int(entry["message"]["slot"])
+                ),
+                "header": entry,
             }
         }
 
@@ -725,11 +1066,9 @@ class BeaconApi:
 
     # -- committees / duties ---------------------------------------------
 
-    def state_committees(self, state_id: str, epoch=None):
-        """GET /eth/v1/beacon/states/{id}/committees."""
+    def _committee_cache(self, st, epoch):
         from ..state_processing.accessors import committee_cache_at
 
-        st = self._state(state_id)
         if epoch is None:
             epoch = compute_epoch_at_slot(st.slot, self.chain.E)
         try:
@@ -737,7 +1076,35 @@ class BeaconApi:
             cc = committee_cache_at(st, epoch, self.chain.E)
         except ValueError as e:
             raise ApiError(400, f"bad epoch: {e}") from e
-        start = compute_start_slot_at_epoch(epoch, self.chain.E)
+        return epoch, cc
+
+    def serve_state_committees(self, state_id: str, epoch=None):
+        """GET /states/{id}/committees → (body, content type): every
+        committee a zero-copy slice of the epoch's shuffled permutation,
+        member lists converted in one C pass per committee."""
+        route = "committees"
+        root, st = self._resolve_state(state_id)
+        epoch_n, cc = self._committee_cache(st, epoch)
+        qnorm = f"epoch={epoch_n}"
+        with span("cache_lookup", route=route):
+            hit = self.response_cache.get(route, root, qnorm)
+        if hit is not None:
+            return hit
+        start = compute_start_slot_at_epoch(epoch_n, self.chain.E)
+        with span("assemble", route=route):
+            text = columnar.assemble_committees(cc, start)
+            columnar.count_assembled(route)
+        with span("serialize", route=route):
+            body = text.encode()
+        self.response_cache.put(route, root, qnorm, body, "application/json")
+        return body, "application/json"
+
+    def state_committees(self, state_id: str, epoch=None):
+        """GET /eth/v1/beacon/states/{id}/committees (per-object oracle:
+        the differential suite pins the columnar body against it)."""
+        st = self._state(state_id)
+        epoch_n, cc = self._committee_cache(st, epoch)
+        start = compute_start_slot_at_epoch(epoch_n, self.chain.E)
         out = []
         for slot in range(start, start + self.chain.E.SLOTS_PER_EPOCH):
             for index in range(cc.committees_per_slot):
@@ -751,6 +1118,98 @@ class BeaconApi:
                     }
                 )
         return {"data": out}
+
+    def serve_headers(self, query=None):
+        """GET /eth/v1/beacon/headers (list form): `slot=` /
+        `parent_root=` filters over the block-root index; default is the
+        head slot's headers (spec). Cached keyed on the head root and
+        evicted on EVERY block event — a fork block changes this listing
+        without moving the head."""
+        route = "headers"
+        query = query or {}
+        chain = self.chain
+        slot_q = query.get("slot")
+        parent_q = query.get("parent_root")
+        if isinstance(slot_q, (list, tuple)):
+            slot_q = slot_q[0]
+        if isinstance(parent_q, (list, tuple)):
+            parent_q = parent_q[0]
+        if slot_q is not None and not str(slot_q).isdigit():
+            raise ApiError(400, f"bad slot {slot_q!r}")
+        if parent_q is not None:
+            try:
+                parent = bytes.fromhex(str(parent_q).removeprefix("0x"))
+            except ValueError as e:
+                raise ApiError(400, f"bad parent_root {parent_q!r}") from e
+            if len(parent) != 32:
+                raise ApiError(400, "parent_root must be 32 bytes")
+        qnorm = f"slot={slot_q}&parent_root={parent_q}"
+        # one root read + one generation snapshot: a block event racing
+        # the build (evicting this route mid-assembly) must not let the
+        # pre-block listing be re-cached as fresh — and the put must key
+        # the SAME root the lookup used
+        head_root = chain.head_root
+        generation = self.response_cache.generation
+        with span("cache_lookup", route=route):
+            hit = self.response_cache.get(route, head_root, qnorm)
+        if hit is not None:
+            return hit
+        index = self.block_index
+        index.sync()
+        if parent_q is not None:
+            roots = index.roots_by_parent(parent)
+            if slot_q is not None:
+                at_slot = set(index.roots_at_slot(int(slot_q)))
+                roots = [r for r in roots if r in at_slot]
+        elif slot_q is not None:
+            roots = index.roots_at_slot(int(slot_q))
+        else:
+            head = chain.head_block()
+            roots = (
+                index.roots_at_slot(int(head.message.slot))
+                if head is not None
+                else []
+            )
+        with span("assemble", route=route):
+            data = []
+            for r in roots:
+                entry = index.header_entry(r)
+                if entry is None:
+                    continue
+                data.append(
+                    {
+                        "root": _hex(r),
+                        "canonical": self._is_canonical(
+                            r, int(entry["message"]["slot"])
+                        ),
+                        "header": entry,
+                    }
+                )
+            columnar.count_assembled(route)
+        with span("serialize", route=route):
+            body = _dump_json(
+                {
+                    "data": data,
+                    "execution_optimistic": False,
+                    "finalized": False,
+                }
+            )
+        self.response_cache.put(
+            route, head_root, qnorm, body, "application/json",
+            if_generation=generation,
+        )
+        return body, "application/json"
+
+    def _is_canonical(self, root: bytes, slot: int) -> bool:
+        if root == self.chain.head_root:
+            return True
+        try:
+            anc = self.chain.fork_choice.proto.proto_array.ancestor_at_slot(
+                self.chain.head_root, slot
+            )
+        except Exception:  # noqa: BLE001 — pruned from proto-array
+            return False
+        return anc == root
 
     def attester_duties(self, epoch: int, indices: list[int]):
         """POST /eth/v1/validator/duties/attester/{epoch}."""
@@ -974,18 +1433,8 @@ _ROUTES = [
     ),
     (
         "GET",
-        r"^/eth/v1/beacon/states/(?P<state_id>[^/]+)/validators$",
-        "state_validators",
-    ),
-    (
-        "GET",
         r"^/eth/v1/beacon/states/(?P<state_id>[^/]+)/validators/(?P<validator_id>[^/]+)$",
         "state_validator",
-    ),
-    (
-        "GET",
-        r"^/eth/v1/beacon/states/(?P<state_id>[^/]+)/validator_balances$",
-        "state_validator_balances",
     ),
     (
         "GET",
@@ -1026,7 +1475,7 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _send_json(self, obj, code=200):
-        body = json.dumps(obj).encode()
+        body = _dump_json(obj)
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -1082,6 +1531,21 @@ class _Handler(BaseHTTPRequestHandler):
         with span("api_request", method="GET", path=path):
             self._dispatch_get(parsed, path)
 
+    def _validator_query(self, parsed) -> dict:
+        """Validators/balances query params: `id` and `status` accept
+        both repeats and comma-separated lists (spec), `limit`/`offset`
+        are the bounded-page extension."""
+        q = parse_qs(parsed.query)
+        out = {}
+        for name in ("id", "status"):
+            vals = [x for v in q.get(name, []) for x in v.split(",") if x]
+            if vals:
+                out[name] = vals
+        for name in ("limit", "offset"):
+            if name in q:
+                out[name] = q[name][0]
+        return out
+
     def _dispatch_get(self, parsed, path):
         try:
             m = re.match(r"^/eth/v2/beacon/blocks/(?P<block_id>[^/]+)$", path)
@@ -1101,9 +1565,36 @@ class _Handler(BaseHTTPRequestHandler):
             if m:
                 q = parse_qs(parsed.query)
                 epoch = q.get("epoch", [None])[0]
-                self._send_json(
-                    self.api.state_committees(m.group("state_id"), epoch)
+                body, ctype = self.api.serve_state_committees(
+                    m.group("state_id"), epoch
                 )
+                self._send_bytes(body, content_type=ctype)
+                return
+            m = re.match(
+                r"^/eth/v1/beacon/states/(?P<state_id>[^/]+)/validators$", path
+            )
+            if m:
+                body, ctype = self.api.serve_state_validators(
+                    m.group("state_id"), self._validator_query(parsed)
+                )
+                self._send_bytes(body, content_type=ctype)
+                return
+            m = re.match(
+                r"^/eth/v1/beacon/states/(?P<state_id>[^/]+)/validator_balances$",
+                path,
+            )
+            if m:
+                body, ctype = self.api.serve_state_validator_balances(
+                    m.group("state_id"),
+                    self._validator_query(parsed),
+                    ssz="application/octet-stream"
+                    in self.headers.get("Accept", ""),
+                )
+                self._send_bytes(body, content_type=ctype)
+                return
+            if path == "/eth/v1/beacon/headers":
+                body, ctype = self.api.serve_headers(parse_qs(parsed.query))
+                self._send_bytes(body, content_type=ctype)
                 return
             m = re.match(
                 r"^/eth/v1/beacon/blob_sidecars/(?P<block_id>[^/]+)$", path
@@ -1160,16 +1651,7 @@ class _Handler(BaseHTTPRequestHandler):
                         k: (int(v) if v.isdigit() and k == "epoch" else v)
                         for k, v in m.groupdict().items()
                     }
-                    if fn_name in ("state_validators", "state_validator_balances"):
-                        q = parse_qs(parsed.query)
-                        ids = q.get("id")
-                        if ids:
-                            ids = [
-                                int(x) if x.isdigit() else x.lower()
-                                for x in ids[0].split(",")
-                            ]
-                        kwargs["indices"] = ids
-                    elif fn_name == "state_randao":
+                    if fn_name == "state_randao":
                         q = parse_qs(parsed.query)
                         ep = q.get("epoch", [None])[0]
                         if ep is not None and not ep.isdigit():
@@ -1328,3 +1810,4 @@ class HttpApiServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+        self.api.close()  # detach cache invalidation from the chain

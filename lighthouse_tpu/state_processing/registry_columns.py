@@ -146,7 +146,14 @@ class RegistryColumns:
     (rule ``stale-read``): undrained dirt means the reader skipped
     `refresh()` and is consuming a stale mirror."""
 
-    __slots__ = ("_cols", "_shared", "_committed", "_sources")
+    __slots__ = (
+        "_cols",
+        "_shared",
+        "_committed",
+        "_sources",
+        "_pubkey_index",
+        "_stamps",
+    )
 
     def __init__(self):
         self._cols: dict[str, np.ndarray] = {}
@@ -155,6 +162,14 @@ class RegistryColumns:
         self._committed: dict[str, object] = {}
         # source field -> the list it mirrors (sanitize-mode audit only)
         self._sources: dict[str, object] = {}
+        # pubkey bytes -> FIRST index (API serving tier); rebuilt lazily,
+        # dropped whenever pubkey rows change or the registry grows
+        self._pubkey_index: dict[bytes, int] | None = None
+        # per-column mutation stamps: bumped on every install AND on
+        # every writable handout (an in-place row write keeps the array
+        # identity, so identity alone can't invalidate derived caches —
+        # the API tier's hex piece caches key on (identity, stamp))
+        self._stamps: dict[str, int] = {}
 
     # -- copy-on-write across state copies ------------------------------
 
@@ -163,6 +178,9 @@ class RegistryColumns:
         out._cols = dict(self._cols)
         out._committed = dict(self._committed)
         out._sources = dict(self._sources)
+        # safe to share: invalidation replaces the dict, never mutates it
+        out._pubkey_index = self._pubkey_index
+        out._stamps = dict(self._stamps)
         shared = set(self._cols)
         out._shared = set(shared)
         self._shared |= shared
@@ -179,11 +197,22 @@ class RegistryColumns:
             # sanctioned writers own their base, so take a writable copy
             arr = np.array(arr, copy=True)
             self._cols[name] = arr
+        self._bump(name)
         return arr
 
     def _install(self, name: str, arr: np.ndarray):
         self._cols[name] = arr
         self._shared.discard(name)
+        self._bump(name)
+
+    def _bump(self, name: str):
+        self._stamps[name] = self._stamps.get(name, 0) + 1
+
+    def column_stamp(self, name: str) -> int:
+        """Mutation stamp of a column — changes whenever the column was
+        replaced OR handed out writable. Derived caches (the API tier's
+        hex piece lists) pair this with the array identity."""
+        return self._stamps.get(name, 0)
 
     # -- column access ----------------------------------------------------
 
@@ -230,6 +259,30 @@ class RegistryColumns:
     @property
     def pubkey_root(self) -> np.ndarray:
         return self._ro("pubkey_root")
+
+    @property
+    def pubkeys(self) -> np.ndarray:
+        """[n, 48] raw pubkey byte matrix (read-only view) — the API
+        serving tier's one-hex-pass source."""
+        return self._ro("pubkey")
+
+    def pubkey_index(self) -> dict[bytes, int]:
+        """pubkey bytes → FIRST index holding it (the spec's
+        by-pubkey lookup semantics when a registry carries duplicates).
+        Built lazily in one pass over the resident matrix, reused until a
+        pubkey row changes or the registry grows — the seed's O(n)
+        per-request scan becomes one dict hit."""
+        m = self._pubkey_index
+        if m is None:
+            raw = self._cols["pubkey"]
+            rows = raw.tobytes()
+            # reversed so the earliest occurrence of a duplicate wins
+            m = {
+                rows[i * 48 : (i + 1) * 48]: i
+                for i in range(raw.shape[0] - 1, -1, -1)
+            }
+            self._pubkey_index = m
+        return m
 
     @property
     def balances(self) -> np.ndarray:
@@ -404,6 +457,10 @@ class RegistryColumns:
                 self._cols["pubkey_root"][idx[changed]] = _hash_pubkeys(
                     pk[changed].tobytes(), int(changed.size)
                 )
+                # registry growth always lands here too (appended rows
+                # are forced into `changed`), so the map can never serve
+                # a shrunken view of a grown registry
+                self._pubkey_index = None
         # sync the "validators" marker column used for size bookkeeping
         self._committed["validators"] = lst.dirt_token_for(COLUMNS_CHANNEL)
         if _san.enabled():
@@ -441,6 +498,7 @@ class RegistryColumns:
             roots = np.zeros((0, 32), dtype=np.uint8)
         self._install("pubkey", raw)
         self._install("pubkey_root", roots)
+        self._pubkey_index = None
         _REBUILDS.inc(field="validators")
 
     # -- writeback (columns → list) --------------------------------------
@@ -506,6 +564,7 @@ class RegistryColumns:
         cur_src = self._sources.pop("current_epoch_participation", None)
         if cur_col is not None:
             self._cols["previous_epoch_participation"] = cur_col
+            self._bump("previous_epoch_participation")
             if "current_epoch_participation" in self._shared:
                 self._shared.add("previous_epoch_participation")
             else:

@@ -55,6 +55,9 @@ def pytest_sessionstart(session):
         registry_columns,  # the columns counters + epoch_stage spans
     )
     import lighthouse_tpu.slasher  # noqa: F401 — registers slasher_* series
+    from lighthouse_tpu.http_api import (  # noqa: F401 — registers api series
+        columnar,  # assembly counter + cache_lookup/assemble/serialize spans
+    )
 
     text = REGISTRY.expose()
     for needle in (
@@ -224,6 +227,24 @@ def pytest_sessionstart(session):
         "beacon_processor_queue_wait_seconds_slasher_process",
         "beacon_processor_work_seconds_slasher_process",
         'beacon_processor_abandoned_total{kind="slasher_process"}',
+        # PR 14: the API serving tier — the zero-copy assembly counter,
+        # the per-route response-cache counters, and the api_request
+        # cache_lookup/assemble/serialize stage spans must exist at zero
+        # (the api_throughput bench reads counter deltas + stage spans
+        # eagerly)
+        'api_columnar_assembly_total{route="validators"}',
+        'api_columnar_assembly_total{route="validator_balances"}',
+        'api_columnar_assembly_total{route="committees"}',
+        'api_columnar_assembly_total{route="headers"}',
+        'api_cache_hits_total{route="validators"}',
+        'api_cache_misses_total{route="validators"}',
+        'api_cache_evictions_total{route="validators"}',
+        'api_cache_hits_total{route="headers"}',
+        'api_cache_misses_total{route="committees"}',
+        'api_cache_evictions_total{route="validator_balances"}',
+        "trace_span_seconds_cache_lookup",
+        "trace_span_seconds_assemble",
+        "trace_span_seconds_serialize",
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"
