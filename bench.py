@@ -1347,6 +1347,136 @@ def bench_gossip_soak(jax):
     }
 
 
+def bench_testnet_soak(jax):
+    """Testnet soak: an N-node in-process fleet (real gossipsub/RPC/
+    beacon_processor/SyncService per node, duties split across per-node
+    VCs) runs healthy epochs, then takes scripted partition-heal cycles.
+    Headline: slots finalized per wall-second across the healthy soak
+    (per-epoch samples give the spread). The recovery story rides along:
+    wall seconds from heal until every node shares one head
+    (head_convergence_s) and until finality advances past the heal point
+    (recovery_to_finality_s), one sample per cycle. The scenario oracle
+    asserts invariants (single head, participation, zero internal
+    errors) between phases — a soak that degrades silently fails loudly
+    instead of reporting a pretty number."""
+    from dataclasses import replace
+
+    from lighthouse_tpu.testing.testnet import (
+        ChainHealthOracle,
+        Testnet,
+        _finalized_epochs,
+        _run_to_convergence,
+    )
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    S = E.SLOTS_PER_EPOCH
+    nodes = 3 if SMOKE else 5
+    validators = 24 if SMOKE else 40
+    soak_epochs = 3 if SMOKE else 5
+    cycles = 1 if SMOKE else 2
+    net = Testnet.create(
+        spec, E, node_count=nodes, validator_count=validators, seed=2026
+    )
+    rates, recoveries, convergences, recovery_slots = [], [], [], []
+    try:
+        oracle = ChainHealthOracle(net)
+        slot = 0
+        fin_slots_prev = 0
+        for ep in range(1, soak_epochs + 1):
+            t0 = time.perf_counter()
+            net.run_until_slot(ep * S, start_slot=slot + 1)
+            slot = ep * S
+            dt = time.perf_counter() - t0
+            fin_slots = max(_finalized_epochs(net)) * S
+            if fin_slots > fin_slots_prev:
+                rates.append((fin_slots - fin_slots_prev) / dt)
+                fin_slots_prev = fin_slots
+            _partial(epoch=ep, finalized_slots=fin_slots, epoch_s=round(dt, 2))
+        oracle.check(
+            require_single_head=True,
+            min_participation=0.9,
+            what="healthy soak",
+        )
+        for cyc in range(cycles):
+            names = [n.name for n in net.nodes]
+            net.rng.shuffle(names)
+            cut = nodes // 2 + 1
+            net.partition(names[:cut], names[cut:])
+            end = slot + S
+            net.run_until_slot(end, start_slot=slot + 1)
+            slot = end
+            net.heal()
+            rec = _run_to_convergence(net, oracle, start_slot=slot + 1)
+            slot += rec["recovery_slots"]
+            recoveries.append(rec["recovery_to_finality_s"])
+            convergences.append(rec["head_convergence_s"])
+            recovery_slots.append(rec["recovery_slots"])
+            _partial(
+                cycle=cyc + 1,
+                of=cycles,
+                recovery_to_finality_s=rec["recovery_to_finality_s"],
+            )
+        oracle.check(require_single_head=True, what="post-cycle fleet")
+    finally:
+        net.shutdown()
+
+    def spread(samples):
+        return {
+            "median_s": round(statistics.median(samples), 3),
+            "min_s": round(min(samples), 3),
+            "max_s": round(max(samples), 3),
+            "trials": len(samples),
+        }
+
+    from lighthouse_tpu.metrics import REGISTRY
+
+    return {
+        "metric": "testnet_soak",
+        "value": round(statistics.median(rates), 2),
+        "unit": (
+            f"slots finalized per wall-second ({nodes}-node fleet, "
+            f"healthy soak)"
+        ),
+        "config": {
+            "nodes": nodes,
+            "validators": validators,
+            "soak_epochs": soak_epochs,
+            "partition_heal_cycles": cycles,
+            "seed": net.seed,
+            "spec": "minimal",
+        },
+        # the robustness headline: wall-clock to recover after a heal
+        "recovery_to_finality": spread(recoveries),
+        "head_convergence": spread(convergences),
+        "recovery_slots": recovery_slots,
+        "counters": {
+            "faults_injected": sum(
+                REGISTRY.counter("testnet_fault_injections_total").value(
+                    kind=k
+                )
+                for k in ("partition", "heal")
+            ),
+            "frames_dropped": REGISTRY.counter(
+                "testnet_gossip_frames_dropped_total"
+            ).value(),
+            "fork_backtracks": REGISTRY.counter(
+                "sync_fork_backtracks_total"
+            ).value(),
+            "oracle_checks_passed": REGISTRY.counter(
+                "scenario_invariant_checks_total"
+            ).value(result="pass"),
+        },
+        "spread": {
+            "median_rate": round(statistics.median(rates), 2),
+            "min_rate": round(min(rates), 2),
+            "max_rate": round(max(rates), 2),
+            "samples": len(rates),
+        },
+    }
+
+
 def bench_fork_choice(jax):
     """Array-program fork choice under a 1M-validator attestation flood:
     per trial, EVERY validator's latest-message vote moves (strictly-newer
@@ -2049,6 +2179,7 @@ _METRICS = {
     "bls": bench_bls,
     "sync_catchup": bench_sync_catchup,
     "gossip_soak": bench_gossip_soak,
+    "testnet_soak": bench_testnet_soak,
     "attestation_batch": bench_attestation_batch,
     "fork_choice": bench_fork_choice,
     "op_pool": bench_op_pool,
@@ -2204,6 +2335,9 @@ def main():
         # 3 flood trials (2 flooder services each) + 3 flood-free
         # controls; fake_crypto, no compiles
         "gossip_soak": 180,
+        # N-node fleet boot + healthy soak epochs + partition-heal
+        # cycles with convergence waits; fake_crypto, no compiles
+        "testnet_soak": 300,
         # 16k-validator fixture + 3 columnar trials + 2 scalar-oracle
         # controls (the controls dominate: ~65k per-validator Python
         # iterations each)

@@ -124,6 +124,12 @@ class BeaconChain:
         self.state_advance_cache = StateAdvanceCache()
         self.invalid_block_roots: set[bytes] = set()
         self._last_finalized_epoch_seen = 0
+        # per-chain reorg accounting: the process-global counter can't
+        # attribute a reorg to ONE node when a testnet fleet shares the
+        # process, and /lighthouse/health's chain block (and the scenario
+        # oracle's max-reorg-depth invariant) need exactly that attribution
+        self.reorgs_total = 0
+        self.max_reorg_depth = 0
         # prepare_beacon_proposer registrations: validator index → fee
         # recipient, consulted when building payload attributes
         self.proposer_preparations: dict[int, bytes] = {}
@@ -284,6 +290,8 @@ class BeaconChain:
             from ..metrics import inc_counter
 
             inc_counter("beacon_chain_reorgs_total")
+            self.reorgs_total += 1
+            self.max_reorg_depth = max(self.max_reorg_depth, int(depth))
             self.event_handler.register_reorg(
                 old_head, new_head, state.slot, depth
             )

@@ -56,6 +56,18 @@ class ClientConfig:
     # the node watches peer Statuses and catches itself up — no caller
     # ever invokes sync_to_head. None disables (tests drive sync by hand).
     sync_service_interval: float | None = 0.5
+    # fleet seams (testing/testnet.py boots N nodes through this builder):
+    # `keypairs` supplies the interop keypair set explicitly so every node
+    # in a fleet derives the IDENTICAL genesis; `vc_keypairs` is the
+    # disjoint share THIS node's VC signs with (default: all of them —
+    # the single-node behavior). `network_cls`/`network_kwargs` swap in a
+    # NetworkService subclass (the scenario fault plane) and pass extra
+    # service knobs (heartbeat cadence, sync config) without the builder
+    # growing a field per knob.
+    keypairs: list | None = None
+    vc_keypairs: list | None = None
+    network_cls: type | None = None
+    network_kwargs: dict = field(default_factory=dict)
 
 
 class Client:
@@ -157,7 +169,11 @@ class ClientBuilder:
                 )
             genesis_state = cfg.genesis_state
         else:
-            c.keypairs = bls.interop_keypairs(cfg.validator_count)
+            c.keypairs = (
+                list(cfg.keypairs)
+                if cfg.keypairs is not None
+                else bls.interop_keypairs(cfg.validator_count)
+            )
             genesis_state = interop_genesis_state(
                 c.keypairs, cfg.genesis_time, b"\x42" * 32, cfg.spec, cfg.E
             )
@@ -214,11 +230,13 @@ class ClientBuilder:
                     else NoiseIdentity()
                 )
                 transport = NoiseTransport(identity)
-            c.network = NetworkService(
+            net_cls = cfg.network_cls if cfg.network_cls is not None else NetworkService
+            c.network = net_cls(
                 c.chain,
                 port=cfg.network_port,
                 transport=transport,
                 sync_service_interval=cfg.sync_service_interval,
+                **cfg.network_kwargs,
             )
         # http (identity/peers routes read the network when present)
         if cfg.http_port is not None:
@@ -236,8 +254,11 @@ class ClientBuilder:
                 if c.network is not None
                 else None
             )
+            vc_keys = (
+                cfg.vc_keypairs if cfg.vc_keypairs is not None else c.keypairs
+            )
             c.vc = ValidatorClient(
-                c.chain, c.keypairs, cfg.spec, cfg.E, node=node
+                c.chain, vc_keys, cfg.spec, cfg.E, node=node
             )
         # slasher (slasher/service feeds off the chain's verified objects)
         if cfg.slasher:

@@ -1509,7 +1509,7 @@ class _Handler(BaseHTTPRequestHandler):
                 content_type="text/plain; version=0.0.4",
             )
             return
-        served = serve_lighthouse_path(path, parsed.query)
+        served = serve_lighthouse_path(path, parsed.query, chain=self.api.chain)
         if served is not None:
             # observability READS (traces/profile/health) stay outside the
             # api_request span — fetching a trace must not push new

@@ -37,11 +37,14 @@ def serve_trace_path(path: str):
     return None
 
 
-def serve_lighthouse_path(path: str, query: str = ""):
+def serve_lighthouse_path(path: str, query: str = "", chain=None):
     """Shared router for every /lighthouse/* operator endpoint (traces,
     profile, health), used verbatim by the MetricsServer and the Beacon
     API. Returns (status, content_type, body_bytes) or None when the
-    path is not a lighthouse endpoint."""
+    path is not a lighthouse endpoint. `chain` (the serving node's
+    BeaconChain, when the caller has one) adds the per-node `chain`
+    block to /lighthouse/health — the single read the testnet scenario
+    oracle asserts its invariants from."""
     traced = serve_trace_path(path)
     if traced is not None:
         code, obj = traced
@@ -83,13 +86,14 @@ def serve_lighthouse_path(path: str, query: str = ""):
         return (
             200,
             "application/json",
-            json.dumps({"data": process_health()}).encode(),
+            json.dumps({"data": process_health(chain=chain)}).encode(),
         )
     return None
 
 
 class _Handler(BaseHTTPRequestHandler):
     registry = REGISTRY
+    chain = None  # bound when the MetricsServer serves a specific node
 
     def log_message(self, *args):  # quiet
         pass
@@ -99,7 +103,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         path, _, query = self.path.partition("?")
         content_type = "text/plain"
-        served = serve_lighthouse_path(path, query)
+        served = serve_lighthouse_path(path, query, chain=self.chain)
         if served is not None:
             code, content_type, body = served
             self.send_response(code)
@@ -125,8 +129,10 @@ class _Handler(BaseHTTPRequestHandler):
 class MetricsServer:
     """http_metrics/src/lib.rs analog."""
 
-    def __init__(self, port: int = 0, registry=REGISTRY):
-        handler = type("_BoundHandler", (_Handler,), {"registry": registry})
+    def __init__(self, port: int = 0, registry=REGISTRY, chain=None):
+        handler = type(
+            "_BoundHandler", (_Handler,), {"registry": registry, "chain": chain}
+        )
         self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._server.server_port
         self._thread: threading.Thread | None = None

@@ -106,12 +106,82 @@ def _proc_self_status_kb(field: str) -> int:
     return 0
 
 
-def process_health() -> dict:
+def chain_health(chain) -> dict:
+    """The `chain` block of /lighthouse/health: one node's chain vitals
+    read off ITS OWN BeaconChain — head slot + lag vs the wall clock,
+    finality position, last-epoch participation, and per-chain reorg
+    accounting. This is deliberately NOT derived from the process-global
+    registry: an in-process testnet fleet shares one registry, and the
+    scenario oracle (testing/testnet.py ChainHealthOracle) needs each
+    node's answer individually — one health GET per node replaces
+    scraping and attributing raw metric series."""
+    from ..state_processing.accessors import compute_epoch_at_slot
+
+    head = chain.head_state
+    head_slot = int(head.slot)
+    clock_slot = int(chain.slot_clock.now())
+    fin = chain.finalized_checkpoint
+    current_epoch = compute_epoch_at_slot(max(head_slot, clock_slot), chain.E)
+    return {
+        "head_slot": head_slot,
+        "head_root": "0x" + chain.head_root.hex(),
+        "clock_slot": clock_slot,
+        "head_lag_slots": max(0, clock_slot - head_slot),
+        "finalized_epoch": int(fin.epoch),
+        "finalized_root": "0x" + bytes(fin.root).hex(),
+        "finalized_distance_epochs": max(0, current_epoch - int(fin.epoch)),
+        "justified_epoch": int(chain.justified_checkpoint.epoch),
+        "participation_prev_epoch": _participation_rate(chain, head),
+        "reorgs_total": int(chain.reorgs_total),
+        "max_reorg_depth": int(chain.max_reorg_depth),
+    }
+
+
+def _participation_rate(chain, state) -> float | None:
+    """Fraction of previous-epoch active (unslashed) validators whose
+    participation flags carry TIMELY_TARGET — the liveness number the
+    chain finalizes on (2/3 of stake; per-validator here, close enough
+    for a health read). None pre-altair (no participation flags)."""
+    flags = getattr(state, "previous_epoch_participation", None)
+    if flags is None:
+        return None
+    from ..state_processing.accessors import get_current_epoch
+    from ..state_processing.altair import TIMELY_TARGET_FLAG_INDEX, has_flag
+    from ..state_processing.registry_columns import registry_columns_for
+
+    prev_epoch = max(0, get_current_epoch(state, chain.E) - 1)
+    cols = registry_columns_for(state)
+    if cols is not None:
+        part = cols.previous_epoch_participation
+        if part is not None:
+            import numpy as np
+
+            active = cols.active_mask(prev_epoch) & ~cols.slashed.astype(bool)
+            n = int(active.sum())
+            if n == 0:
+                return None
+            hit = (part[active] >> TIMELY_TARGET_FLAG_INDEX) & 1
+            return round(float(np.count_nonzero(hit)) / n, 4)
+    from ..state_processing.accessors import is_active_validator
+
+    n = hit = 0
+    for i, v in enumerate(state.validators):
+        if v.slashed or not is_active_validator(v, prev_epoch):
+            continue
+        n += 1
+        if has_flag(int(flags[i]), TIMELY_TARGET_FLAG_INDEX):
+            hit += 1
+    return round(hit / n, 4) if n else None
+
+
+def process_health(chain=None) -> dict:
     """The /lighthouse/health body (the reference's /lighthouse/ui/health
     analog): process vitals plus node state read back out of the
     process-global registry's gauges — uptime, RSS/peak RSS, GC
     generation counts, live threads, sync state, worker-busy ratio, and
-    the trace-collector ring size."""
+    the trace-collector ring size. With a `chain` (the Beacon API serves
+    one; the standalone MetricsServer may not have one), the body gains
+    the per-node `chain` block."""
     import gc
 
     from . import REGISTRY
@@ -120,6 +190,7 @@ def process_health() -> dict:
     workers = REGISTRY.gauge("beacon_processor_workers_total").value()
     busy = REGISTRY.gauge("beacon_processor_workers_busy").value()
     return {
+        **({"chain": chain_health(chain)} if chain is not None else {}),
         "uptime_seconds": round(time.monotonic() - PROCESS_START_MONOTONIC, 3),
         "started_at_unix": int(PROCESS_START_EPOCH),
         "rss_bytes": _proc_self_status_kb("VmRSS") * 1024,

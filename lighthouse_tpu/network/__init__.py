@@ -541,6 +541,23 @@ class GossipRouter:
         self._relay_thread.join(timeout=2)
 
     def _send_frame(self, peer_id: str, payload: bytes):
+        """Every outbound gossip frame (data AND control: GRAFT/PRUNE/
+        IHAVE) crosses the service's egress seam first — the fault plane
+        the testnet harness scripts partitions/eclipses/late-delivery
+        through. None = the edge is dark (frame dropped); >0 = delivered
+        that many seconds late on a timer thread (the caller — relay
+        thread, heartbeat — must never sleep for an injected delay)."""
+        delay = self.service.egress_delay(peer_id)
+        if delay is None:
+            return
+        if delay > 0:
+            t = threading.Timer(delay, self._send_frame_now, args=(peer_id, payload))
+            t.daemon = True
+            t.start()
+            return
+        self._send_frame_now(peer_id, payload)
+
+    def _send_frame_now(self, peer_id: str, payload: bytes):
         peer = self.service.peers.get(peer_id)
         if peer is None:
             return
@@ -884,6 +901,16 @@ class NetworkService:
         self.processor.shutdown()
         self.reprocess.clear()
 
+    # -- fault-plane seam --------------------------------------------------------
+
+    def egress_delay(self, peer_id: str) -> float | None:
+        """Gossip egress policy for one outbound frame to `peer_id`:
+        0.0 = send now (production behavior), a positive value = deliver
+        that late, None = drop (the edge is dark). The testnet fault
+        plane (testing/testnet.py) overrides this to script partitions,
+        eclipses, and late-delivery regimes over otherwise-real nodes."""
+        return 0.0
+
     # -- identity / status ------------------------------------------------------
 
     def fork_digest(self) -> bytes:
@@ -951,6 +978,12 @@ class NetworkService:
         # BEFORE the reader starts: the remote's SUBSCRIBE frames arrive
         # immediately and would be dropped for an unknown peer
         self.gossip.behaviour.add_peer(peer.peer_id)
+        # a fresh peer may be the way out of a capped sync backoff or a
+        # negatively-cached lookup root (partition heal): wake the loop
+        # instead of sleeping it out, and void the "nobody had it" verdicts
+        self.sync.lookups.peer_connected()
+        if self.sync_service is not None:
+            self.sync_service.on_peer_connected()
         t = threading.Thread(
             target=self._gossip_reader,
             args=(peer.gossip_sock, peer.peer_id),
@@ -998,6 +1031,9 @@ class NetworkService:
                 pass
             return
         self.gossip.behaviour.add_peer(peer.peer_id)
+        self.sync.lookups.peer_connected()
+        if self.sync_service is not None:
+            self.sync_service.on_peer_connected()
         self._gossip_reader(sock, peer.peer_id)
 
     def _gossip_reader(self, sock, peer_id: str):

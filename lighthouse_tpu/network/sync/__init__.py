@@ -36,6 +36,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ...metrics import REGISTRY, inc_counter, set_gauge
+from ...utils.logging import get_logger
 from ..rpc import RpcError
 from .backfill import BackfillSync, verify_backfill_signatures
 from .batch import Batch, BatchState
@@ -56,6 +57,8 @@ __all__ = [
     "SyncingChain",
     "verify_backfill_signatures",
 ]
+
+log = get_logger("lighthouse_tpu.sync")
 
 # sync_state gauge values (SyncState in sync/manager.rs)
 SYNC_STATE_STALLED = 0
@@ -80,6 +83,11 @@ def _register_metrics():
     REGISTRY.counter("sync_lookups_completed_total").inc(0)
     REGISTRY.counter("sync_lookups_failed_total").inc(0)
     REGISTRY.counter("sync_lookup_reprocess_drained_total").inc(0)
+    REGISTRY.counter(
+        "sync_fork_backtracks_total",
+        "range-sync runs restarted from the finalized boundary after "
+        "batch 0 hit an unknown parent (local head on a competing fork)",
+    ).inc(0)
     for method in ("blocks_by_range", "blocks_by_root", "blob_sidecars_by_root"):
         REGISTRY.counter("sync_rpc_requests_total").inc(0, method=method)
     set_gauge("sync_state", SYNC_STATE_STALLED)
@@ -186,16 +194,47 @@ class SyncManager:
             set_sync_state(SYNC_STATE_SYNCED)
             return 0
         set_sync_state(SYNC_STATE_RANGE)
-        syncing = SyncingChain(
-            self.service,
-            self.ctx,
-            peers,
-            start_slot=chain.head_state.slot + 1,
-            target_slot=target_slot,
-            config=self.config,
-        )
+        imported = 0
+        start_slot = int(chain.head_state.slot) + 1
         try:
-            imported = syncing.run()
+            for _attempt in range(2):
+                syncing = SyncingChain(
+                    self.service,
+                    self.ctx,
+                    peers,
+                    start_slot=start_slot,
+                    target_slot=target_slot,
+                    config=self.config,
+                )
+                imported += syncing.run()
+                if not syncing.fork_suspected:
+                    break
+                # batch 0 hit an unknown parent: our head sits on a fork
+                # of the serving peers' chain. Restart ONCE from the
+                # finalized boundary — the shared prefix re-downloads and
+                # skips at import, and the competing chain attaches at
+                # its true branch point (range_sync/chain.rs syncs from
+                # the finalized epoch for exactly this reason). Without
+                # this, every retry indicted an honest peer until whole
+                # healed partitions were banned.
+                from ...state_processing.accessors import (
+                    compute_start_slot_at_epoch,
+                )
+
+                fin_start = compute_start_slot_at_epoch(
+                    int(chain.finalized_checkpoint.epoch), chain.E
+                )
+                backtrack = max(int(chain.anchor_slot), fin_start) + 1
+                if backtrack >= start_slot:
+                    break  # already at the boundary: a genuinely bad span
+                inc_counter("sync_fork_backtracks_total")
+                log.info(
+                    "range sync backtracking to finalized boundary",
+                    from_slot=start_slot,
+                    to_slot=backtrack,
+                    target=target_slot,
+                )
+                start_slot = backtrack
         finally:
             set_sync_state(
                 SYNC_STATE_SYNCED

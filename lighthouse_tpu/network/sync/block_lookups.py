@@ -13,6 +13,7 @@ moment its block lands.
 from __future__ import annotations
 
 import threading
+import time
 
 from ...beacon_processor import WorkType
 from ...metrics import inc_counter
@@ -21,6 +22,13 @@ from ...utils.tracing import span
 from ..rpc import RpcError
 
 log = get_logger("lighthouse_tpu.sync.lookups")
+
+#: a root NOBODY in the pool had is not retried for this long — an
+#: unknown-root gossip flood would otherwise re-trigger a whole-pool
+#: blocks_by_root sweep per spam message (the rotation now spans every
+#: connected peer, so the negative cache is what bounds amplification)
+LOOKUP_NEGATIVE_TTL_S = 3.0
+_NEGATIVE_CACHE_MAX = 4096
 
 
 class BlockLookups:
@@ -32,10 +40,20 @@ class BlockLookups:
         #: roots with a lookup thread live — gossip floods the same
         #: unknown root from many peers; only the first spawns work
         self._inflight: set[bytes] = set()
+        #: root -> monotonic stamp of its last FAILED lookup (bounded;
+        #: entries expire after LOOKUP_NEGATIVE_TTL_S)
+        self._recent_failures: dict[bytes, float] = {}
         self._stopping = False
 
     def stop(self):
         self._stopping = True
+
+    def peer_connected(self):
+        """A fresh peer voids every negative-cache entry: "nobody had
+        it" was a verdict on the OLD pool (same principle as the sync
+        service's backoff reset — a reconnect/heal is a new chance)."""
+        with self._lock:
+            self._recent_failures.clear()
 
     def inflight_count(self) -> int:
         with self._lock:
@@ -62,6 +80,11 @@ class BlockLookups:
         with self._lock:
             if root in self._inflight:
                 return False
+            failed_at = self._recent_failures.get(root)
+            if failed_at is not None:
+                if time.monotonic() - failed_at < LOOKUP_NEGATIVE_TTL_S:
+                    return False  # the whole pool just said no — back off
+                del self._recent_failures[root]
             self._inflight.add(root)
         inc_counter("sync_lookups_started_total", kind=kind)
         threading.Thread(
@@ -84,6 +107,22 @@ class BlockLookups:
         finally:
             with self._lock:
                 self._inflight.discard(root)
+                if not ok:
+                    now = time.monotonic()
+                    if len(self._recent_failures) >= _NEGATIVE_CACHE_MAX:
+                        # drop expired entries first; if a burst of
+                        # distinct roots is all still fresh, evict oldest
+                        # (insertion order) — the table stays bounded
+                        self._recent_failures = {
+                            r: t
+                            for r, t in self._recent_failures.items()
+                            if now - t < LOOKUP_NEGATIVE_TTL_S
+                        }
+                        while len(self._recent_failures) >= _NEGATIVE_CACHE_MAX:
+                            self._recent_failures.pop(
+                                next(iter(self._recent_failures))
+                            )
+                    self._recent_failures[root] = now
         if ok:
             inc_counter("sync_lookups_completed_total")
         else:
@@ -135,14 +174,21 @@ class BlockLookups:
     def _fetch_root(self, root: bytes):
         """One ancestor by root, rotating across alive peers (shared
         ranking: score then idleness); a peer that answers with a
-        DIFFERENT block than asked is lying and pays for it."""
+        DIFFERENT block than asked is lying and pays for it.
+
+        The rotation bound is the whole connected pool, not the retry
+        budget: an honest "I don't have it" (empty response) is cheap,
+        and after a partition heal the peers holding a competing fork's
+        blocks may all rank BELOW same-side peers — a fixed 3-attempt cap
+        kept asking the half that couldn't answer and the fleet never
+        converged."""
         from .. import SCORE_INVALID_MESSAGE
 
+        pool = self.service.peers.peers()
+        attempts = max(self.cfg.lookup_max_attempts, len(pool))
         tried: set[str] = set()
-        for _ in range(self.cfg.lookup_max_attempts):
-            peer = self.ctx.select_peer(
-                self.service.peers.peers(), exclude=tried
-            )
+        for _ in range(attempts):
+            peer = self.ctx.select_peer(pool, exclude=tried)
             if peer is None:
                 return None
             tried.add(peer.peer_id)

@@ -50,6 +50,12 @@ class SyncingChain:
         self._downloads = 0
         self.imported = 0
         self.failed = False
+        #: set when batch 0 failed import on an unknown PARENT: the span
+        #: starts above our fork's branch point, so the serving peer may
+        #: be honestly serving a COMPETING canonical chain — the caller
+        #: should restart from the finalized boundary instead of letting
+        #: retries indict (and eventually ban) half the network
+        self.fork_suspected = False
         self.batches: dict[int, Batch] = {}
         batch_span = config.epochs_per_batch * self.chain.E.SLOTS_PER_EPOCH
         s = int(start_slot)
@@ -266,6 +272,19 @@ class SyncingChain:
             peer=batch.peer_id,
             error=str(result.error)[:120],
         )
+        # Batch 0 failing on "parent unknown" means the CHAIN's start
+        # slot sits above a fork's branch point — our head is not on the
+        # peer's canonical chain. That indicts our window placement, not
+        # the peer: it honestly served its chain (the post-partition heal
+        # scenario banned entire healed halves through this downscore).
+        # Flag it, fail the run fast (no retry — so no retry counter),
+        # and let the manager restart from the finalized boundary.
+        if batch.id == 0 and "parent unknown" in str(result.error):
+            self.fork_suspected = True
+            batch.state = BatchState.FAILED
+            self.failed = True
+            inc_counter("sync_batch_failures_total", chain="range")
+            return
         inc_counter("sync_batch_retries_total", chain="range")
         # the failed batch's peer is directly implicated (invalid block,
         # or a first block whose parent nobody delivered)

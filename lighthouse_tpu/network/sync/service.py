@@ -27,6 +27,7 @@ the live backoff is exported as `sync_service_backoff_seconds`.
 from __future__ import annotations
 
 import threading
+import time
 
 from ...metrics import REGISTRY, inc_counter, set_gauge
 from ...utils.logging import get_logger
@@ -40,6 +41,14 @@ for _result in ("caught_up", "progress", "failed"):
         "sync_service_runs_total",
         "autonomous range-sync runs, by outcome",
     ).inc(0, result=_result)
+for _reason in ("new_serving_peer", "peer_connected"):
+    REGISTRY.counter(
+        "sync_service_backoff_resets_total",
+        "capped-backoff resets outside the normal progress path: a new "
+        "serving peer appeared (the backoff was earned against the OLD "
+        "peer set — partition heal, eclipse lifted), or a fresh "
+        "connection woke the sleeping loop early",
+    ).inc(0, reason=_reason)
 set_gauge("sync_service_backoff_seconds", 0)
 
 
@@ -51,6 +60,7 @@ class SyncService:
         head_lag_slots: int = 2,
         backoff_base_s: float = 0.5,
         backoff_max_s: float = 30.0,
+        status_poll_interval: float = 5.0,
     ):
         self.manager = manager
         self.service = manager.service
@@ -58,10 +68,27 @@ class SyncService:
         #: tolerated head lag before catch-up starts: one slot of lag is
         #: ordinary gossip latency, not a reason to open a range sync
         self.head_lag_slots = head_lag_slots
+        #: Status refresh cadence while SYNCED. The loop used to Status-
+        #: poll every peer every `interval` even at head — a per-tick RPC
+        #: storm that drained the server-side Status rate-limit buckets
+        #: (keyed by remote HOST on plain TCP, so co-hosted nodes share
+        #: one bucket) until even fresh dials' handshakes were refused —
+        #: the 10-node partition-heal scenario could never reconnect.
+        #: Local head lag is computable for free; only a lagging node
+        #: polls eagerly.
+        self.status_poll_interval = status_poll_interval
+        self._last_status_poll = 0.0
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
         self._consecutive_failures = 0
         self._stop = threading.Event()
+        #: set to cut a backoff sleep short (peer connected, stop): a
+        #: node that earned a 30 s backoff against a dead peer set must
+        #: not serve out that sentence after the partition heals
+        self._wake = threading.Event()
+        #: serving-peer ids the last tick saw (empty after a tick with no
+        #: candidates — so the post-heal tick sees returning peers as NEW)
+        self._last_serving_ids: set[str] = set()
         self._thread: threading.Thread | None = None
         #: total catch-up runs attempted (tests read this)
         self.runs = 0
@@ -84,8 +111,24 @@ class SyncService:
         self._thread.start()
         return self
 
+    def on_peer_connected(self):
+        """NetworkService reports every fresh peer registration here: the
+        loop wakes immediately instead of sleeping out a backoff earned
+        against the pre-connection peer set (recovery-time-to-finality
+        after a partition heal was previously floored by backoff_max).
+        Only wakes that actually cut a backoff count as resets — boot-time
+        mesh dials must not drown the regression-sentinel series in
+        connection churn."""
+        if self.running and not self._wake.is_set():
+            if self._consecutive_failures:
+                inc_counter(
+                    "sync_service_backoff_resets_total", reason="peer_connected"
+                )
+            self._wake.set()
+
     def stop(self, timeout: float = 5.0):
         self._stop.set()
+        self._wake.set()
         t = self._thread
         if t is not None:
             t.join(timeout)
@@ -108,7 +151,11 @@ class SyncService:
         )
 
     def _loop(self):
-        while not self._stop.wait(self.interval + self.backoff_s()):
+        while True:
+            self._wake.wait(self.interval + self.backoff_s())
+            self._wake.clear()
+            if self._stop.is_set():
+                return
             try:
                 self._tick()
             except Exception as e:  # noqa: BLE001 — the loop must outlive faults
@@ -119,11 +166,34 @@ class SyncService:
 
     def _tick(self):
         chain = self.service.chain
+        # Status polls cost every peer's server a token from a shared
+        # bucket: a node at its head has no reason to spend them every
+        # tick. Poll eagerly only when LOCALLY behind the wall clock;
+        # otherwise refresh peer statuses at `status_poll_interval`.
+        local_lag = int(chain.slot_clock.now()) - int(chain.head_state.slot)
+        now = time.monotonic()
+        if (
+            local_lag <= self.head_lag_slots
+            and now - self._last_status_poll < self.status_poll_interval
+        ):
+            return
+        self._last_status_poll = now
         # the shared candidate policy (SyncManager.poll_sync_candidates):
         # dead/stale peers drop out; only peers advertising a head past
         # ours serve catch-up batches (flooders at slot 0 would otherwise
         # poison the rotation with empty windows — seen in the storm sim)
         candidates, serving, target = self.manager.poll_sync_candidates()
+        # a serving peer we have NOT been failing against voids the
+        # accumulated backoff: the failures were earned against the old
+        # peer set (all-peers-vanished partitions, eclipse liars), and
+        # punishing the healed topology for them stalls recovery
+        serving_ids = {p.peer_id for p in serving}
+        if self._consecutive_failures and serving_ids - self._last_serving_ids:
+            self._consecutive_failures = 0
+            inc_counter(
+                "sync_service_backoff_resets_total", reason="new_serving_peer"
+            )
+        self._last_serving_ids = serving_ids
         if not candidates:
             return
         # a Status head_slot is attacker-controlled: clamp to the wall

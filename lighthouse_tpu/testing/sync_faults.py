@@ -10,6 +10,12 @@ deterministic scripts ("truncate the first response, then behave").
 The injected faults mirror the adversary matrix the sync engine is built
 against (BENCH_NOTES.md "Sync subsystem" documents the expected handling
 for each row).
+
+This is the single-peer ancestor of the fleet-scale fault plane:
+testing/testnet.py generalizes these per-peer scripts into a
+topology-wide `FaultPlane` (partitions, eclipses, delays, floods,
+equivocation) over N full nodes, with chain-health invariants as the
+oracle — see SCENARIOS.md.
 """
 
 from __future__ import annotations

@@ -1,0 +1,1312 @@
+"""Adversarial in-process testnet fleet: scripted fault regimes over N
+real nodes, with chain-health invariants as the oracle.
+
+The ROADMAP's "adversarial many-node scenario fleet": `Testnet` boots
+N full nodes through the production `ClientBuilder` — real gossipsub
+v1.1 mesh, real RPC over loopback sockets, real beacon_processor lanes,
+the autonomous SyncService, optional slasher, and a per-node Beacon API
+server — all sharing one interop genesis, with validator duties split
+across per-node VCs exactly as testing/simulator does for its two-node
+sims.
+
+On top of that sits a programmable **fault plane** (`FaultPlane`), the
+generalization of testing/sync_faults.py from one lying peer to a whole
+topology: every node's `TestnetNetworkService` consults the shared plane
+on every outbound gossip frame (the NetworkService.egress_delay seam)
+and on every dial, so a scenario can
+
+  * `partition` the fleet into halves that build competing forks, then
+    `heal` and watch proto-array reorg everyone onto one head;
+  * `eclipse` a victim behind liar peers (gossip dark, Status handshake
+    alive and lying) and assert it recovers once honest peers return;
+  * `delay` edges past the attestation propagation window;
+  * `flood` gossip lanes from attacker nodes (no VC, pure spam);
+  * make a proposer `equivocate`, which must surface through the PR 13
+    slasher's SLASHER_PROCESS lane as exactly one ProposerSlashing.
+
+The **oracle** (`ChainHealthOracle`) asserts invariants from each node's
+/lighthouse/health `chain` block — participation rate, head lag vs the
+clock, max reorg depth, finality advancement, post-heal single-head
+convergence — plus the process-wide zero-internal-error counters. One
+HTTP GET per node; no raw metric-series scraping.
+
+Every scenario takes an explicit RNG seed; a failing run logs it and
+`LIGHTHOUSE_TPU_SCENARIO_SEED` replays the exact topology/fault draw.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from urllib.request import urlopen
+
+from ..client import Client, ClientBuilder, ClientConfig
+from ..crypto import bls
+from ..metrics import REGISTRY, inc_counter
+from ..network import NetworkService
+from ..network import messages as M
+from ..network.rpc import RpcError
+from ..network.sync import SyncConfig
+from ..state_processing import per_slot_processing
+from ..state_processing.accessors import (
+    compute_epoch_at_slot,
+    get_beacon_proposer_index,
+    get_domain,
+)
+from ..types.chain_spec import Domain, compute_signing_root
+from ..utils.logging import get_logger
+
+log = get_logger("lighthouse_tpu.testnet")
+
+TESTNET_GENESIS_TIME = 1_600_000_000
+
+FAULT_KINDS = ("partition", "heal", "eclipse", "delay", "flood", "equivocation")
+# eager registration: the scenario_smoke tier-1 run and dashboards read
+# these series before the first fault is ever injected
+for _kind in FAULT_KINDS:
+    REGISTRY.counter(
+        "testnet_fault_injections_total",
+        "scripted fault-plane verbs applied by the scenario harness",
+    ).inc(0, kind=_kind)
+REGISTRY.counter(
+    "testnet_gossip_frames_dropped_total",
+    "outbound gossip frames the fault plane turned dark (partition/"
+    "eclipse edges)",
+).inc(0)
+REGISTRY.counter(
+    "testnet_gossip_frames_delayed_total",
+    "outbound gossip frames the fault plane delivered late",
+).inc(0)
+for _result in ("pass", "fail"):
+    REGISTRY.counter(
+        "scenario_invariant_checks_total",
+        "ChainHealthOracle invariant evaluations, by outcome",
+    ).inc(0, result=_result)
+
+
+class ScenarioFailure(AssertionError):
+    """An invariant the oracle (or a scenario assertion) failed — the
+    message always carries the scenario's seed for exact replay."""
+
+
+def scenario_seed(default: int) -> int:
+    """The scenario's RNG seed: LIGHTHOUSE_TPU_SCENARIO_SEED overrides
+    the scripted default so a failing run replays exactly."""
+    env = os.environ.get("LIGHTHOUSE_TPU_SCENARIO_SEED")
+    return int(env) if env else int(default)
+
+
+# ---------------------------------------------------------------------------
+# fault plane
+
+
+class FaultPlane:
+    """The shared programmable network shim. Nodes register their listen
+    address; scenarios script directed edge state; every node's
+    TestnetNetworkService queries it on each outbound gossip frame and
+    each dial. Three edge states compose:
+
+      * blocked — fully dark: gossip dropped, dials refused, existing
+        connections severed by the harness (a partition's cross edges);
+      * muted   — gossip dropped but the connection (and its Status
+        RPC) stays up: the eclipse liar's edge, silence plus lies;
+      * delayed — frames delivered N seconds late on a timer thread.
+
+    `status_extra` inflates a node's advertised Status head_slot (the
+    sync_faults stale/lying-Status fault, now per-node)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._node_by_addr: dict[tuple[str, int], str] = {}
+        self._blocked: set[tuple[str, str]] = set()
+        self._muted: set[tuple[str, str]] = set()
+        self._delays: dict[tuple[str, str], float] = {}
+        self._lies: dict[str, int] = {}
+
+    # -- registry ---------------------------------------------------------
+
+    def register(self, node_id: str, host: str, port: int):
+        with self._lock:
+            self._node_by_addr[(host, int(port))] = node_id
+
+    def node_for(self, host: str, port: int) -> str | None:
+        with self._lock:
+            return self._node_by_addr.get((host, int(port)))
+
+    # -- queries (hot path: every outbound frame) -------------------------
+
+    def edge(self, src: str, dst: str) -> float | None:
+        """Gossip egress policy src→dst: None = drop, else delay secs."""
+        with self._lock:
+            pair = (src, dst)
+            if pair in self._blocked or pair in self._muted:
+                return None
+            return self._delays.get(pair, 0.0)
+
+    def dial_allowed(self, src: str, dst: str) -> bool:
+        with self._lock:
+            return (src, dst) not in self._blocked
+
+    def status_extra(self, node_id: str) -> int:
+        with self._lock:
+            return self._lies.get(node_id, 0)
+
+    # -- verbs ------------------------------------------------------------
+
+    def block_pair(self, a: str, b: str):
+        with self._lock:
+            self._blocked.add((a, b))
+            self._blocked.add((b, a))
+
+    def partition(self, *groups):
+        """Nodes in different groups can no longer exchange anything."""
+        for i, ga in enumerate(groups):
+            for gb in groups[i + 1 :]:
+                for a in ga:
+                    for b in gb:
+                        self.block_pair(a, b)
+
+    def mute(self, src: str, dst: str):
+        with self._lock:
+            self._muted.add((src, dst))
+
+    def delay(self, src: str, dst: str, seconds: float, symmetric: bool = True):
+        with self._lock:
+            self._delays[(src, dst)] = float(seconds)
+            if symmetric:
+                self._delays[(dst, src)] = float(seconds)
+
+    def lie_status(self, node_id: str, extra_head_slots: int):
+        with self._lock:
+            if extra_head_slots:
+                self._lies[node_id] = int(extra_head_slots)
+            else:
+                self._lies.pop(node_id, None)
+
+    def heal(self):
+        """Clear every scripted fault (the registry survives)."""
+        with self._lock:
+            self._blocked.clear()
+            self._muted.clear()
+            self._delays.clear()
+            self._lies.clear()
+
+    # -- topology ---------------------------------------------------------
+
+    def components(self, node_ids: list[str]) -> list[set[str]]:
+        """Connected components of `node_ids` under the CURRENT plane:
+        an undirected edge is usable iff neither direction is blocked or
+        muted. The settle loop only waits for head convergence within a
+        component — partitioned halves are not expected to agree."""
+        with self._lock:
+            blocked = self._blocked | self._muted
+        usable = lambda a, b: (a, b) not in blocked and (b, a) not in blocked
+        remaining = set(node_ids)
+        out = []
+        while remaining:
+            seed_node = remaining.pop()
+            comp = {seed_node}
+            frontier = [seed_node]
+            while frontier:
+                cur = frontier.pop()
+                for other in list(remaining):
+                    if usable(cur, other):
+                        remaining.discard(other)
+                        comp.add(other)
+                        frontier.append(other)
+            out.append(comp)
+        return out
+
+
+class TestnetNetworkService(NetworkService):
+    """A real NetworkService whose egress and dials cross the fault
+    plane, and whose advertised Status can lie (the sync_faults
+    stale-status fault generalized to a fleet verb)."""
+
+    def __init__(self, chain, *, plane: FaultPlane, node_id: str, **kwargs):
+        self.plane = plane
+        self.node_id = node_id
+        super().__init__(chain, **kwargs)
+
+    def _peer_node(self, peer_id: str) -> str | None:
+        host, _, port = peer_id.rpartition(":")
+        try:
+            return self.plane.node_for(host, int(port))
+        except ValueError:
+            return None
+
+    def egress_delay(self, peer_id: str) -> float | None:
+        dst = self._peer_node(peer_id)
+        if dst is None:
+            return 0.0  # unregistered peer (e.g. mid-registration): pass
+        d = self.plane.edge(self.node_id, dst)
+        if d is None:
+            inc_counter("testnet_gossip_frames_dropped_total")
+        elif d > 0:
+            inc_counter("testnet_gossip_frames_delayed_total")
+        return d
+
+    def connect(self, host: str, port: int):
+        dst = self.plane.node_for(host, port)
+        if dst is not None and not self.plane.dial_allowed(self.node_id, dst):
+            raise RpcError(
+                f"fault plane: edge {self.node_id} -> {dst} is dark"
+            )
+        return super().connect(host, port)
+
+    def local_status(self) -> M.StatusMessage:
+        st = super().local_status()
+        extra = self.plane.status_extra(self.node_id)
+        if not extra:
+            return st
+        return M.StatusMessage(
+            fork_digest=st.fork_digest,
+            finalized_root=st.finalized_root,
+            finalized_epoch=st.finalized_epoch,
+            head_root=st.head_root,
+            head_slot=int(st.head_slot) + extra,
+        )
+
+
+# ---------------------------------------------------------------------------
+# the fleet
+
+
+@dataclass
+class TestnetNode:
+    name: str
+    client: Client
+    is_attacker: bool = False
+
+    @property
+    def chain(self):
+        return self.client.chain
+
+    @property
+    def network(self):
+        return self.client.network
+
+    @property
+    def vc(self):
+        return self.client.vc
+
+    @property
+    def health_url(self) -> str:
+        return f"http://127.0.0.1:{self.client.http_server.port}/lighthouse/health"
+
+
+#: sync tuning for scenario runs: test-speed backoffs, and a parent-walk
+#: depth that covers a whole partitioned epoch so post-heal gossip blocks
+#: can pull the competing fork in via lookups
+def scenario_sync_config(E) -> SyncConfig:
+    return SyncConfig(
+        backoff_base_s=0.02,
+        backoff_max_s=0.25,
+        batch_timeout_s=5.0,
+        chain_timeout_s=30.0,
+        lookup_max_depth=4 * E.SLOTS_PER_EPOCH,
+    )
+
+
+@dataclass
+class Testnet:
+    __test__ = False  # "Test" prefix: not a pytest collection target
+
+    spec: object
+    E: object
+    plane: FaultPlane
+    seed: int
+    rng: random.Random
+    keypairs: list = field(default_factory=list)
+    nodes: list[TestnetNode] = field(default_factory=list)
+    attackers: list[TestnetNode] = field(default_factory=list)
+    _flood_stop: threading.Event = field(default_factory=threading.Event)
+    _flood_threads: list = field(default_factory=list)
+    flood_sent: int = 0
+
+    # -- boot -------------------------------------------------------------
+
+    @classmethod
+    def create(
+        cls,
+        spec,
+        E,
+        node_count: int = 3,
+        validator_count: int = 24,
+        *,
+        seed: int = 0,
+        slasher_nodes: set[int] = frozenset(),
+        attacker_count: int = 0,
+        bls_backend: str = "fake_crypto",
+        heartbeat_interval: float = 0.05,
+        sync_service_interval: float | None = 0.1,
+        full_mesh_max: int = 12,
+    ) -> "Testnet":
+        """Boot `node_count` full nodes (ClientBuilder each: chain +
+        fault-planed network + Beacon API + VC over a disjoint key share)
+        plus `attacker_count` VC-less attacker nodes, and wire the mesh:
+        full mesh up to `full_mesh_max` nodes, ring + seeded random
+        chords beyond (50 nodes must not open 1225×2 sockets)."""
+        seed = scenario_seed(seed)
+        rng = random.Random(seed)
+        keypairs = bls.interop_keypairs(validator_count)
+        plane = FaultPlane()
+        net = cls(
+            spec=spec, E=E, plane=plane, seed=seed, rng=rng, keypairs=keypairs
+        )
+        share = validator_count // node_count
+        for i in range(node_count):
+            lo = i * share
+            hi = validator_count if i == node_count - 1 else lo + share
+            net._boot_node(
+                f"node{i}",
+                vc_keypairs=keypairs[lo:hi],
+                slasher=(i in slasher_nodes),
+                bls_backend=bls_backend,
+                heartbeat_interval=heartbeat_interval,
+                sync_service_interval=sync_service_interval,
+            )
+        for i in range(attacker_count):
+            net._boot_node(
+                f"attacker{i}",
+                vc_keypairs=[],
+                slasher=False,
+                bls_backend=bls_backend,
+                heartbeat_interval=heartbeat_interval,
+                sync_service_interval=None,  # attackers never self-sync
+                attacker=True,
+            )
+        net._wire_mesh(full_mesh_max)
+        time.sleep(0.2)  # let inbound-peer registration settle
+        return net
+
+    def _boot_node(
+        self,
+        name: str,
+        *,
+        vc_keypairs,
+        slasher: bool,
+        bls_backend: str,
+        heartbeat_interval: float,
+        sync_service_interval: float | None,
+        attacker: bool = False,
+    ) -> TestnetNode:
+        cfg = ClientConfig(
+            spec=self.spec,
+            E=self.E,
+            validator_count=len(self.keypairs),
+            keypairs=self.keypairs,
+            vc_keypairs=vc_keypairs,
+            validate=not attacker,
+            slasher=slasher,
+            bls_backend=bls_backend,
+            http_port=0,
+            network_port=0,
+            manual_slot_clock=True,
+            genesis_time=TESTNET_GENESIS_TIME,
+            sync_service_interval=sync_service_interval,
+            network_cls=TestnetNetworkService,
+            network_kwargs=dict(
+                plane=self.plane,
+                node_id=name,
+                heartbeat_interval=heartbeat_interval,
+                sync_config=scenario_sync_config(self.E),
+            ),
+        )
+        client = ClientBuilder(cfg).build().start()
+        if client.network.sync_service is not None:
+            # scenario time constants: react to a heal within a slot or
+            # two instead of the production 5 s status refresh
+            client.network.sync_service.status_poll_interval = 1.0
+        self.plane.register(name, "127.0.0.1", client.network.port)
+        node = TestnetNode(name, client, is_attacker=attacker)
+        (self.attackers if attacker else self.nodes).append(node)
+        return node
+
+    def _wire_mesh(self, full_mesh_max: int):
+        fleet = self.nodes
+        if len(fleet) <= full_mesh_max:
+            edges = [
+                (i, j) for i in range(len(fleet)) for j in range(i)
+            ]
+        else:
+            # ring + 2 seeded chords per node: connected, low-degree
+            n = len(fleet)
+            edges = {(i, (i + 1) % n) for i in range(n)}
+            for i in range(n):
+                for _ in range(2):
+                    j = self.rng.randrange(n)
+                    if j != i:
+                        edges.add((max(i, j), min(i, j)))
+            edges = sorted({(max(a, b), min(a, b)) for a, b in edges})
+        self._mesh_edges = edges
+        for i, j in edges:
+            fleet[i].network.connect("127.0.0.1", fleet[j].network.port)
+        # attackers each dial one seeded fleet node
+        for att in self.attackers:
+            target = self.rng.choice(fleet)
+            att.network.connect("127.0.0.1", target.network.port)
+
+    # -- driving ----------------------------------------------------------
+
+    def node(self, name: str) -> TestnetNode:
+        for n in self.nodes + self.attackers:
+            if n.name == name:
+                return n
+        raise KeyError(name)
+
+    def set_slot(self, slot: int):
+        for n in self.nodes + self.attackers:
+            n.client.slot_clock.set_slot(slot)
+
+    def run_slot(self, slot: int, propose: bool = True):
+        """One slot in protocol order across the fleet: tick every clock,
+        whichever VC holds the proposal proposes, gossip settles, then
+        every VC attests + aggregates (the reference VC's 0s / slot/3
+        intra-slot schedule, event-driven instead of timed)."""
+        self.set_slot(slot)
+        if propose:
+            for n in self.nodes:
+                try:
+                    n.vc.block_service.propose_if_due(slot)
+                except Exception as e:  # noqa: BLE001 — a partitioned/eclipsed
+                    # proposer missing its duty is scenario-normal
+                    log.info("proposal missed", node=n.name, error=str(e)[:120])
+        self.settle()
+        for n in self.nodes:
+            try:
+                head = n.chain.head_root
+                n.vc.attestation_service.attest(slot, head)
+                n.vc.attestation_service.aggregate_if_selected(slot)
+            except Exception as e:  # noqa: BLE001
+                log.info("attestation missed", node=n.name, error=str(e)[:120])
+        self.settle()
+
+    def run_until_slot(self, end_slot: int, start_slot: int):
+        for slot in range(start_slot, end_slot + 1):
+            self.run_slot(slot)
+
+    def settle(self, timeout: float = 5.0):
+        """Wait for gossip convergence WITHIN each fault-plane component:
+        all fleet heads in a component equal (partitioned halves converge
+        separately; an eclipsed victim is a singleton and never blocks)."""
+        comps = self.plane.components([n.name for n in self.nodes])
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            done = True
+            for comp in comps:
+                heads = {self.node(nm).chain.head_root for nm in comp}
+                if len(heads) > 1:
+                    done = False
+                    break
+            if done:
+                return
+            time.sleep(0.02)
+
+    def wait_for(self, predicate, timeout: float = 20.0, what: str = "condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            time.sleep(0.05)
+        raise ScenarioFailure(
+            f"[seed={self.seed}] timed out waiting for {what}"
+        )
+
+    # -- fault verbs -------------------------------------------------------
+
+    def partition(self, *groups):
+        """Split the fleet: nodes in different groups go fully dark to
+        each other (frames dropped, dials refused, live connections
+        severed)."""
+        inc_counter("testnet_fault_injections_total", kind="partition")
+        self.plane.partition(*[list(g) for g in groups])
+        self._enforce_disconnects()
+        log.info("partition applied", seed=self.seed, groups=[list(g) for g in groups])
+
+    def heal(self):
+        """Clear every fault and re-dial the original mesh; sleeping sync
+        backoffs wake via the peer-connected hook."""
+        inc_counter("testnet_fault_injections_total", kind="heal")
+        self.plane.heal()
+        self._flood_stop.set()
+        self._reconnect_mesh()
+        log.info("fault plane healed", seed=self.seed)
+
+    def eclipse(self, victim: str, liars: list[str], lie_extra_slots: int = 64):
+        """Eclipse `victim`: dark to every honest fleet node; `liars`
+        (attacker nodes) keep their connection to the victim up but mute
+        gossip toward it and advertise a head `lie_extra_slots` ahead —
+        the victim sees only silence and lies. The liars ALSO go dark to
+        the honest fleet: their chains freeze at eclipse start, so the
+        victim cannot quietly range-sync the real chain through the
+        attackers' RPC (that leak made early drafts of this scenario a
+        slow relay, not an eclipse)."""
+        inc_counter("testnet_fault_injections_total", kind="eclipse")
+        for n in self.nodes:
+            if n.name != victim and n.name not in liars:
+                self.plane.block_pair(victim, n.name)
+                for liar in liars:
+                    self.plane.block_pair(liar, n.name)
+        for liar in liars:
+            self.plane.mute(liar, victim)
+            self.plane.lie_status(liar, lie_extra_slots)
+            # liars must actually be connected to the victim
+            liar_node = self.node(liar)
+            victim_port = self.node(victim).network.port
+            if not self._connected(liar_node, victim_port):
+                liar_node.network.connect("127.0.0.1", victim_port)
+        self._enforce_disconnects()
+        log.info("eclipse applied", victim=victim, liars=liars, seed=self.seed)
+
+    def delay_edges_of(self, name: str, seconds: float):
+        """Deliver every gossip frame to/from `name` late (the
+        late-block/late-attestation regime)."""
+        inc_counter("testnet_fault_injections_total", kind="delay")
+        for n in self.nodes:
+            if n.name != name:
+                self.plane.delay(name, n.name, seconds)
+
+    def start_flood(self, rate_sleep: float = 0.001):
+        """Attacker nodes flood decodable unknown-root attestations (the
+        worst honest-looking spam) into their fleet targets' gossip
+        lanes until heal()/stop_flood()."""
+        inc_counter("testnet_fault_injections_total", kind="flood")
+        self._flood_stop.clear()
+
+        def flood_loop(att: TestnetNode, lane: int):
+            t = att.chain.types
+            E = self.E
+            sent = 0
+            garbage = [bytes([0x70 + lane]) * 31 + bytes([j]) for j in range(8)]
+            while not self._flood_stop.is_set():
+                slot = int(att.client.slot_clock.now())
+                root = garbage[sent % len(garbage)]
+                att_obj = t.Attestation(
+                    aggregation_bits=[True],
+                    data=t.AttestationData(
+                        slot=slot,
+                        index=0,
+                        beacon_block_root=root,
+                        source=t.Checkpoint(epoch=0, root=b"\x00" * 32),
+                        target=t.Checkpoint(
+                            epoch=slot // E.SLOTS_PER_EPOCH, root=root
+                        ),
+                    ),
+                    signature=(lane * (1 << 40) + sent).to_bytes(8, "little")
+                    + bytes(88),
+                )
+                att.network.gossip.publish(
+                    att.network.topic_att, t.Attestation.serialize_value(att_obj)
+                )
+                sent += 1
+                self.flood_sent += 1
+                time.sleep(rate_sleep)
+
+        self._flood_threads = [
+            threading.Thread(
+                target=flood_loop, args=(att, lane), daemon=True,
+                name=f"testnet-flood-{att.name}",
+            )
+            for lane, att in enumerate(self.attackers)
+        ]
+        for th in self._flood_threads:
+            th.start()
+
+    def stop_flood(self):
+        self._flood_stop.set()
+        for th in self._flood_threads:
+            th.join(timeout=5)
+        self._flood_threads = []
+
+    def equivocate(self, slot: int, node_name: str | None = None) -> int:
+        """Make `slot`'s proposer (computed on `node_name`'s head) sign
+        TWO competing blocks and publish both — the double proposal the
+        slasher must turn into exactly one ProposerSlashing. Returns the
+        proposer's validator index. Call with the clock at `slot` and
+        INSTEAD of the slot's normal proposal (run_slot(propose=False))."""
+        inc_counter("testnet_fault_injections_total", kind="equivocation")
+        node = self.node(node_name) if node_name else self.nodes[0]
+        chain = node.chain
+        st = chain.head_state.copy()
+        while st.slot < slot:
+            per_slot_processing(st, self.spec, self.E)
+        proposer = get_beacon_proposer_index(st, self.E)
+        sk = self.keypairs[proposer].sk
+        epoch = compute_epoch_at_slot(slot, self.E)
+        randao_domain = get_domain(st, Domain.RANDAO, epoch, self.spec, self.E)
+        randao = sk.sign(
+            compute_signing_root(
+                epoch.to_bytes(8, "little").ljust(32, b"\x00"), randao_domain
+            )
+        ).to_bytes()
+        # produce BOTH before importing either: the second must be a
+        # competing sibling, not a child of the first
+        b1, _ = chain.produce_block_on_state(slot, randao, graffiti=b"\x11" * 32)
+        b2, _ = chain.produce_block_on_state(slot, randao, graffiti=b"\x22" * 32)
+        t = chain.types
+        prop_domain = get_domain(
+            st, Domain.BEACON_PROPOSER, epoch, self.spec, self.E
+        )
+        signed = []
+        for blk in (b1, b2):
+            sig = sk.sign(
+                compute_signing_root(blk.hash_tree_root(), prop_domain)
+            ).to_bytes()
+            tf = t.types_for_fork(t.fork_of_block(blk))
+            signed.append(tf.SignedBeaconBlock(message=blk, signature=sig))
+        for s in signed:
+            chain.process_block(s)
+            node.network.publish_block(s)
+        log.info(
+            "proposer equivocated", slot=slot, proposer=proposer,
+            node=node.name, seed=self.seed,
+        )
+        return proposer
+
+    # -- plane enforcement -------------------------------------------------
+
+    @staticmethod
+    def _connected(node: TestnetNode, port: int) -> bool:
+        pid = f"127.0.0.1:{port}"
+        return any(p.peer_id == pid for p in node.network.peers.peers())
+
+    def _enforce_disconnects(self):
+        """Sever live connections whose edge just went dark — a
+        partition is connectivity loss, not polite silence."""
+        everyone = self.nodes + self.attackers
+        for a in everyone:
+            for b in everyone:
+                if a is b or self.plane.dial_allowed(a.name, b.name):
+                    continue
+                pid = f"127.0.0.1:{b.network.port}"
+                peer = a.network.peers.get(pid)
+                if peer is not None:
+                    a.network._drop_peer(peer)
+
+    def _reconnect_mesh(self):
+        for i, j in self._mesh_edges:
+            a, b = self.nodes[i], self.nodes[j]
+            for attempt in range(3):
+                if self._connected(a, b.network.port):
+                    break
+                try:
+                    a.network.connect("127.0.0.1", b.network.port)
+                    break
+                except (RpcError, OSError) as e:
+                    # e.g. a still-draining Status rate-limit bucket —
+                    # refill and retry before declaring the edge dead
+                    if attempt == 2:
+                        log.warning(
+                            "mesh re-dial failed", edge=(a.name, b.name),
+                            error=str(e)[:120],
+                        )
+                    else:
+                        time.sleep(0.3)
+
+    # -- teardown ----------------------------------------------------------
+
+    def shutdown(self):
+        self.stop_flood()
+        for n in self.nodes + self.attackers:
+            try:
+                n.client.stop()
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                log.warning("node stop failed", node=n.name, error=str(e)[:120])
+
+
+# ---------------------------------------------------------------------------
+# the oracle
+
+
+#: process-wide counters that must stay FLAT across a scenario: any
+#: increase means a node hit an internal fault (our bug, not the
+#: adversary's traffic) — the scenarios' strictest invariant
+INTERNAL_ERROR_SERIES = (
+    "gossip_internal_error_total",
+    "beacon_processor_errors_total",
+)
+
+
+class ChainHealthOracle:
+    """Asserts chain-health invariants from each node's
+    /lighthouse/health `chain` block (one HTTP GET per node — the PR's
+    single-endpoint contract), plus the process-wide internal-error
+    counters snapshotted at construction."""
+
+    def __init__(self, net: Testnet):
+        self.net = net
+        self._error_base = self._error_counts()
+
+    @staticmethod
+    def _error_counts() -> dict[str, float]:
+        out = {}
+        for name in INTERNAL_ERROR_SERIES:
+            # lint: allow(metric-hygiene) -- reading the fixed module-constant series above, not minting new ones
+            out[name] = sum(REGISTRY.counter(name).values().values())
+        return out
+
+    def health(self, node: TestnetNode) -> dict:
+        with urlopen(node.health_url, timeout=10) as resp:
+            return json.loads(resp.read())["data"]
+
+    def chain_block(self, node: TestnetNode) -> dict:
+        data = self.health(node)
+        if "chain" not in data:
+            raise ScenarioFailure(
+                f"[seed={self.net.seed}] {node.name}: /lighthouse/health "
+                "has no chain block"
+            )
+        return data["chain"]
+
+    def check(
+        self,
+        nodes: list[TestnetNode] | None = None,
+        *,
+        max_head_lag: int | None = None,
+        min_participation: float | None = None,
+        min_finalized_epoch: int | None = None,
+        max_finalized_distance: int | None = None,
+        max_reorg_depth: int | None = None,
+        require_single_head: bool = False,
+        zero_internal_errors: bool = True,
+        what: str = "invariants",
+    ) -> list[dict]:
+        """Evaluate the requested invariant set over `nodes` (default:
+        the whole fleet); raises ScenarioFailure listing every violation
+        with the scenario seed. Returns the per-node chain blocks so
+        scenarios can report them."""
+        nodes = nodes if nodes is not None else self.net.nodes
+        failures = []
+        blocks = []
+        heads = set()
+        for node in nodes:
+            c = self.chain_block(node)
+            blocks.append(c)
+            heads.add(c["head_root"])
+            if max_head_lag is not None and c["head_lag_slots"] > max_head_lag:
+                failures.append(
+                    f"{node.name}: head lag {c['head_lag_slots']} > "
+                    f"{max_head_lag} (head {c['head_slot']}, clock "
+                    f"{c['clock_slot']})"
+                )
+            part = c["participation_prev_epoch"]
+            if min_participation is not None and (
+                part is None or part < min_participation
+            ):
+                failures.append(
+                    f"{node.name}: participation {part} < {min_participation}"
+                )
+            if (
+                min_finalized_epoch is not None
+                and c["finalized_epoch"] < min_finalized_epoch
+            ):
+                failures.append(
+                    f"{node.name}: finalized epoch {c['finalized_epoch']} < "
+                    f"{min_finalized_epoch}"
+                )
+            if (
+                max_finalized_distance is not None
+                and c["finalized_distance_epochs"] > max_finalized_distance
+            ):
+                failures.append(
+                    f"{node.name}: finality distance "
+                    f"{c['finalized_distance_epochs']} > {max_finalized_distance}"
+                )
+            if (
+                max_reorg_depth is not None
+                and c["max_reorg_depth"] > max_reorg_depth
+            ):
+                failures.append(
+                    f"{node.name}: reorg depth {c['max_reorg_depth']} > "
+                    f"{max_reorg_depth}"
+                )
+        if require_single_head and len(heads) != 1:
+            failures.append(f"heads diverged: {sorted(heads)}")
+        if zero_internal_errors:
+            now = self._error_counts()
+            for name, base in self._error_base.items():
+                if now[name] > base:
+                    failures.append(
+                        f"internal errors: {name} rose {base} -> {now[name]}"
+                    )
+        if failures:
+            inc_counter("scenario_invariant_checks_total", result="fail")
+            msg = "; ".join(failures)
+            log.error(
+                "oracle check failed — replay with "
+                f"LIGHTHOUSE_TPU_SCENARIO_SEED={self.net.seed}",
+                what=what,
+            )
+            raise ScenarioFailure(f"[seed={self.net.seed}] {what}: {msg}")
+        inc_counter("scenario_invariant_checks_total", result="pass")
+        return blocks
+
+
+# ---------------------------------------------------------------------------
+# scripted scenarios (tests and the testnet_soak bench both drive these)
+
+
+def _finalized_epochs(net: Testnet) -> list[int]:
+    return [int(n.chain.finalized_checkpoint.epoch) for n in net.nodes]
+
+
+def run_smoke_scenario(spec, E, *, seed: int = 101) -> dict:
+    """Tier-1 scenario_smoke: 3 nodes run healthy for 2 epochs (single
+    head, finality moving), take a short partition that forks the fleet,
+    heal, and converge with finality advancing — the whole tentpole
+    contract at the smallest shape that still exercises it."""
+    net = Testnet.create(spec, E, node_count=3, validator_count=24, seed=seed)
+    try:
+        oracle = ChainHealthOracle(net)
+        S = E.SLOTS_PER_EPOCH
+        net.run_until_slot(2 * S, start_slot=1)
+        oracle.check(
+            max_head_lag=1,
+            min_participation=0.9,
+            min_finalized_epoch=0,
+            require_single_head=True,
+            what="healthy baseline",
+        )
+        fin_before = max(_finalized_epochs(net))
+        # seeded split: one node alone vs the majority pair
+        lone = net.rng.choice(net.nodes).name
+        rest = [n.name for n in net.nodes if n.name != lone]
+        net.partition([lone], rest)
+        net.run_until_slot(2 * S + S // 2, start_slot=2 * S + 1)
+        net.heal()
+        recovery = _run_to_convergence(net, oracle, start_slot=2 * S + S // 2 + 1)
+        oracle.check(
+            require_single_head=True,
+            min_finalized_epoch=fin_before + 1,
+            max_reorg_depth=S,
+            what="post-heal convergence",
+        )
+        return {"seed": net.seed, **recovery}
+    finally:
+        net.shutdown()
+
+
+def _run_to_convergence(
+    net: Testnet,
+    oracle: ChainHealthOracle,
+    start_slot: int,
+    max_epochs: int = 6,
+    min_finalized_advance: int = 1,
+) -> dict:
+    """Post-heal driver: keep running slots until every node shares one
+    head AND finality advanced `min_finalized_advance` past the heal
+    point. Returns recovery timings for the soak bench."""
+    E = net.E
+    S = E.SLOTS_PER_EPOCH
+    fin_at_heal = max(_finalized_epochs(net))
+    t0 = time.perf_counter()
+    converged_at = None
+    slot = start_slot
+    for slot in range(start_slot, start_slot + max_epochs * S):
+        net.run_slot(slot)
+        heads = {n.chain.head_root for n in net.nodes}
+        if len(heads) == 1 and converged_at is None:
+            converged_at = time.perf_counter() - t0
+        if (
+            len(heads) == 1
+            and min(_finalized_epochs(net)) >= fin_at_heal + min_finalized_advance
+        ):
+            return {
+                "recovery_slots": slot - start_slot + 1,
+                "head_convergence_s": round(converged_at, 3),
+                "recovery_to_finality_s": round(time.perf_counter() - t0, 3),
+            }
+    raise ScenarioFailure(
+        f"[seed={net.seed}] fleet did not re-converge within "
+        f"{max_epochs} epochs of heal (heads="
+        f"{ {n.name: n.chain.head_root.hex()[:8] for n in net.nodes} }, "
+        f"finalized={_finalized_epochs(net)}, fin_at_heal={fin_at_heal})"
+    )
+
+
+def run_partition_heal_scenario(
+    spec,
+    E,
+    *,
+    node_count: int = 6,
+    validator_count: int = 36,
+    seed: int = 1,
+    partition_epochs: int = 1,
+) -> dict:
+    """Halves build competing forks, heal, converge to ONE head with
+    finality advancing — the proto-array reorg regime at fleet scale."""
+    net = Testnet.create(
+        spec, E, node_count=node_count, validator_count=validator_count, seed=seed
+    )
+    try:
+        oracle = ChainHealthOracle(net)
+        S = E.SLOTS_PER_EPOCH
+        net.run_until_slot(2 * S, start_slot=1)
+        oracle.check(
+            require_single_head=True,
+            min_participation=0.9,
+            min_finalized_epoch=0,
+            what="healthy baseline",
+        )
+        fin_before = max(_finalized_epochs(net))
+        # seeded uneven split: majority side keeps > half the validators
+        names = [n.name for n in net.nodes]
+        net.rng.shuffle(names)
+        cut = node_count // 2 + 1
+        side_a, side_b = names[:cut], names[cut:]
+        net.partition(side_a, side_b)
+        part_start = 2 * S + 1
+        net.run_until_slot(2 * S + partition_epochs * S, start_slot=part_start)
+        heads_a = {net.node(nm).chain.head_root for nm in side_a}
+        heads_b = {net.node(nm).chain.head_root for nm in side_b}
+        if heads_a & heads_b:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] partition built no competing forks "
+                f"(halves share a head) — the scenario proved nothing"
+            )
+        net.heal()
+        recovery = _run_to_convergence(
+            net, oracle, start_slot=2 * S + partition_epochs * S + 1
+        )
+        blocks = oracle.check(
+            require_single_head=True,
+            min_finalized_epoch=fin_before + 1,
+            max_reorg_depth=(partition_epochs + 1) * S,
+            what="post-heal convergence",
+        )
+        return {
+            "seed": net.seed,
+            "sides": [side_a, side_b],
+            "max_reorg_depth": max(c["max_reorg_depth"] for c in blocks),
+            **recovery,
+        }
+    finally:
+        net.shutdown()
+
+
+def run_eclipse_scenario(
+    spec,
+    E,
+    *,
+    node_count: int = 4,
+    validator_count: int = 32,
+    seed: int = 2,
+    eclipse_epochs: int = 3,
+) -> dict:
+    """A victim is eclipsed behind lying attacker peers: the honest fleet
+    keeps finalizing, the victim falls behind (lag grows, its sync runs
+    fail against the liars), and once honest peers are re-admitted it
+    recovers to the fleet head."""
+    net = Testnet.create(
+        spec,
+        E,
+        node_count=node_count,
+        validator_count=validator_count,
+        seed=seed,
+        attacker_count=2,
+    )
+    try:
+        oracle = ChainHealthOracle(net)
+        S = E.SLOTS_PER_EPOCH
+        net.run_until_slot(S, start_slot=1)
+        oracle.check(require_single_head=True, what="healthy baseline")
+        victim = net.rng.choice(net.nodes).name
+        honest = [n for n in net.nodes if n.name != victim]
+        net.eclipse(victim, [a.name for a in net.attackers])
+        # run the eclipse until honest finality MOVES (capped): at 3/4
+        # participation, justification timing rides the attestation
+        # inclusion tail, so a fixed end slot flakes by an epoch
+        end = S + eclipse_epochs * S
+        net.run_until_slot(end, start_slot=S + 1)
+        while end < S + (eclipse_epochs + 3) * S and not all(
+            int(n.chain.finalized_checkpoint.epoch) >= 1
+            for n in net.nodes
+            if n.name != victim
+        ):
+            end += 1
+            net.run_slot(end)
+        vic = net.node(victim)
+        # the victim is dark: strictly behind the honest fleet, and on
+        # its OWN fork (it keeps self-proposing with its key share, so
+        # head-slot lag alone would be a weak isolation proof)
+        honest_head_slot = max(int(n.chain.head_state.slot) for n in honest)
+        victim_gap = honest_head_slot - int(vic.chain.head_state.slot)
+        if victim_gap <= 0:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] eclipse leaked: victim kept pace "
+                f"(gap={victim_gap})"
+            )
+        if vic.chain.head_root in {n.chain.head_root for n in honest}:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] eclipse leaked: victim shares the "
+                "honest head"
+            )
+        # at 3/4 participation justification needs the full inclusion
+        # tail, so finality trails the boundary by an extra epoch — the
+        # invariant is that it MOVES, not that it is prompt
+        oracle.check(
+            nodes=honest,
+            require_single_head=True,
+            min_finalized_epoch=1,
+            what="honest fleet under eclipse",
+        )
+        failed_runs_during = REGISTRY.counter("sync_service_runs_total").value(
+            result="failed"
+        )
+        net.heal()
+        # keep the chain moving while the victim catches up
+        recovery = _run_to_convergence(net, oracle, start_slot=end + 1)
+        oracle.check(
+            require_single_head=True,
+            max_head_lag=1,
+            what="victim recovered",
+        )
+        return {
+            "seed": net.seed,
+            "victim": victim,
+            "victim_gap_slots": victim_gap,
+            "sync_failed_runs_during_eclipse": failed_runs_during,
+            **recovery,
+        }
+    finally:
+        net.shutdown()
+
+
+def run_late_delivery_scenario(
+    spec,
+    E,
+    *,
+    node_count: int = 4,
+    validator_count: int = 32,
+    seed: int = 3,
+    delay_s: float = 0.35,
+    delayed_epochs: int = 1,
+) -> dict:
+    """Every gossip frame to/from one node arrives `delay_s` late while
+    the fleet paces slots faster than that: blocks and attestations land
+    outside their propagation windows, are IGNOREd/parked — never
+    internal errors — and the fleet re-converges once the delay lifts."""
+    net = Testnet.create(
+        spec, E, node_count=node_count, validator_count=validator_count, seed=seed
+    )
+    try:
+        oracle = ChainHealthOracle(net)
+        S = E.SLOTS_PER_EPOCH
+        net.run_until_slot(S, start_slot=1)
+        oracle.check(require_single_head=True, what="healthy baseline")
+        ignored_before = REGISTRY.counter("gossip_ignored_total").value()
+        laggard = net.rng.choice(net.nodes).name
+        net.delay_edges_of(laggard, delay_s)
+        end = S + delayed_epochs * S
+        for slot in range(S + 1, end + 1):
+            net.set_slot(slot)
+            for n in net.nodes:
+                try:
+                    n.vc.block_service.propose_if_due(slot)
+                except Exception:  # noqa: BLE001 — scenario-normal misses
+                    pass
+            # pace faster than the injected delay: no settle barrier, so
+            # the laggard's frames genuinely arrive in later slots
+            time.sleep(min(delay_s / 3, 0.1))
+            for n in net.nodes:
+                try:
+                    n.vc.attestation_service.attest(slot, n.chain.head_root)
+                except Exception:  # noqa: BLE001
+                    pass
+        net.heal()
+        recovery = _run_to_convergence(net, oracle, start_slot=end + 1)
+        oracle.check(
+            require_single_head=True,
+            max_head_lag=1,
+            what="post-delay convergence",
+        )
+        ignored_delta = (
+            REGISTRY.counter("gossip_ignored_total").value() - ignored_before
+        )
+        return {
+            "seed": net.seed,
+            "laggard": laggard,
+            "gossip_ignored_delta": ignored_delta,
+            **recovery,
+        }
+    finally:
+        net.shutdown()
+
+
+def run_gossip_flood_scenario(
+    spec,
+    E,
+    *,
+    node_count: int = 4,
+    validator_count: int = 32,
+    seed: int = 4,
+    flood_epochs: int = 3,
+) -> dict:
+    """Attacker nodes sustain an unknown-root attestation flood into the
+    fleet's gossip lanes while duties keep running: the chain must keep
+    finalizing, the excess must shed through counted drops (reprocess
+    caps, processor backpressure) — never internal errors, never a hang."""
+    net = Testnet.create(
+        spec,
+        E,
+        node_count=node_count,
+        validator_count=validator_count,
+        seed=seed,
+        attacker_count=2,
+    )
+    try:
+        oracle = ChainHealthOracle(net)
+        S = E.SLOTS_PER_EPOCH
+        net.run_until_slot(S, start_slot=1)
+        oracle.check(require_single_head=True, what="healthy baseline")
+        shed_before = _flood_shed_counters()
+        net.start_flood()
+        # half an epoch of margin past the last boundary: finality lands
+        # fin(N-2) entering epoch N on this chain's justification cadence
+        end = S + flood_epochs * S + S // 2
+        net.run_until_slot(end, start_slot=S + 1)
+        net.stop_flood()
+        blocks = oracle.check(
+            require_single_head=True,
+            min_finalized_epoch=flood_epochs - 2,
+            min_participation=0.8,
+            what="fleet under flood",
+        )
+        shed_delta = {
+            k: v - shed_before[k] for k, v in _flood_shed_counters().items()
+        }
+        if net.flood_sent and not any(shed_delta.values()):
+            # nothing held/dropped/ignored — the flood never landed
+            raise ScenarioFailure(
+                f"[seed={net.seed}] flood sent {net.flood_sent} messages "
+                f"but no shed counter moved: {shed_delta}"
+            )
+        recovery = _run_to_convergence(net, oracle, start_slot=end + 1)
+        return {
+            "seed": net.seed,
+            "flood_sent": net.flood_sent,
+            "shed": shed_delta,
+            "finalized": [c["finalized_epoch"] for c in blocks],
+            **recovery,
+        }
+    finally:
+        net.shutdown()
+
+
+def _flood_shed_counters() -> dict[str, float]:
+    return {
+        "gossip_ignored_total": REGISTRY.counter("gossip_ignored_total").value(),
+        "reprocess_held_total": REGISTRY.counter("reprocess_held_total").value(),
+        "dropped_gossip_attestation": REGISTRY.counter(
+            "beacon_processor_dropped_total"
+        ).value(kind="gossip_attestation"),
+    }
+
+
+def run_equivocation_scenario(
+    spec,
+    E,
+    *,
+    node_count: int = 3,
+    validator_count: int = 24,
+    seed: int = 5,
+) -> dict:
+    """A proposer signs two competing blocks; both ride gossip to an
+    OBSERVER node running the slasher, whose SLASHER_PROCESS lane must
+    emit exactly ONE ProposerSlashing into its op pool — the end-to-end
+    gossip → detection → emission contract."""
+    net = Testnet.create(
+        spec,
+        E,
+        node_count=node_count,
+        validator_count=validator_count,
+        seed=seed,
+        slasher_nodes={1},  # observer only: proves gossip delivery
+    )
+    try:
+        oracle = ChainHealthOracle(net)
+        S = E.SLOTS_PER_EPOCH
+        observer = net.nodes[1]
+        found_before = REGISTRY.counter("slasher_slashings_found_total").value(
+            kind="proposer"
+        )
+        cycles_before = _slasher_cycles()
+        net.run_until_slot(S, start_slot=1)
+        # seeded equivocation slot inside epoch 1, proposed from node0
+        eq_slot = S + 1 + net.rng.randrange(S - 1)
+        for slot in range(S + 1, 2 * S + 1):
+            if slot == eq_slot:
+                net.set_slot(slot)
+                proposer = net.equivocate(slot, node_name="node0")
+                net.run_slot(slot, propose=False)
+            else:
+                net.run_slot(slot)
+        # both blocks must have reached the observer via gossip
+        net.wait_for(
+            lambda: sum(
+                1
+                for b in observer.chain._blocks_by_root.values()
+                if int(b.message.slot) == eq_slot
+            )
+            >= 2,
+            what="observer imported both equivocating blocks",
+        )
+        # cross the epoch edge: the slasher claims+processes epoch 1 on
+        # its SLASHER_PROCESS lane at the first tick of epoch 2
+        net.run_until_slot(3 * S, start_slot=2 * S + 1)
+        net.wait_for(
+            lambda: REGISTRY.counter("slasher_slashings_found_total").value(
+                kind="proposer"
+            )
+            >= found_before + 1,
+            what="proposer slashing emitted",
+        )
+        # exactly one — the dedup contract, across another full epoch of
+        # cycles re-seeing the same header pair
+        net.run_until_slot(4 * S, start_slot=3 * S + 1)
+        found_delta = (
+            REGISTRY.counter("slasher_slashings_found_total").value(
+                kind="proposer"
+            )
+            - found_before
+        )
+        if found_delta != 1:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] expected exactly 1 proposer slashing, "
+                f"got {found_delta}"
+            )
+        # the emission either still sits in the observer's op pool, or a
+        # proposal already packed it and the validator is slashed on
+        # chain (the pool prunes included ops) — both complete the loop
+        pooled = proposer in observer.chain.op_pool._proposer_slashings
+        on_chain = bool(observer.chain.head_state.validators[proposer].slashed)
+        if not (pooled or on_chain):
+            raise ScenarioFailure(
+                f"[seed={net.seed}] proposer {proposer}'s slashing neither "
+                "pooled on the observer nor included on chain"
+            )
+        lane_cycles = _slasher_cycles() - cycles_before
+        if lane_cycles <= 0:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] no SLASHER_PROCESS cycles ran"
+            )
+        oracle.check(require_single_head=True, what="fleet after equivocation")
+        return {
+            "seed": net.seed,
+            "equivocation_slot": eq_slot,
+            "proposer": proposer,
+            "slashings_emitted": found_delta,
+            "slasher_cycles": lane_cycles,
+        }
+    finally:
+        net.shutdown()
+
+
+def _slasher_cycles() -> float:
+    c = REGISTRY.counter("slasher_process_cycles_total")
+    return c.value(engine="columnar") + c.value(engine="reference")

@@ -58,6 +58,9 @@ def pytest_sessionstart(session):
     from lighthouse_tpu.http_api import (  # noqa: F401 — registers api series
         columnar,  # assembly counter + cache_lookup/assemble/serialize spans
     )
+    from lighthouse_tpu.testing import (  # noqa: F401 — registers testnet_*
+        testnet,  # fault-injection/drop/delay counters + oracle outcomes
+    )
 
     text = REGISTRY.expose()
     for needle in (
@@ -245,6 +248,24 @@ def pytest_sessionstart(session):
         "trace_span_seconds_cache_lookup",
         "trace_span_seconds_assemble",
         "trace_span_seconds_serialize",
+        # PR 15: the testnet scenario harness — fault-plane verbs, frame
+        # drop/delay accounting, oracle outcomes, and the peer-lifecycle
+        # recovery counters the partition/heal scenarios assert — must
+        # exist at zero (the testnet_soak bench and scenario_smoke read
+        # them eagerly)
+        'testnet_fault_injections_total{kind="partition"}',
+        'testnet_fault_injections_total{kind="heal"}',
+        'testnet_fault_injections_total{kind="eclipse"}',
+        'testnet_fault_injections_total{kind="delay"}',
+        'testnet_fault_injections_total{kind="flood"}',
+        'testnet_fault_injections_total{kind="equivocation"}',
+        "testnet_gossip_frames_dropped_total",
+        "testnet_gossip_frames_delayed_total",
+        'scenario_invariant_checks_total{result="pass"}',
+        'scenario_invariant_checks_total{result="fail"}',
+        'sync_service_backoff_resets_total{reason="new_serving_peer"}',
+        'sync_service_backoff_resets_total{reason="peer_connected"}',
+        "sync_fork_backtracks_total",
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"

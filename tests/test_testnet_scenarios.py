@@ -1,0 +1,445 @@
+"""Adversarial in-process testnet fleet (testing/testnet.py).
+
+Tier-1 runs the 3-node `scenario_smoke` (partition → heal → converge),
+the 4-node eclipse-and-recover and 3-node equivocating-proposer regimes,
+the /lighthouse/health `chain` block, and directed regression tests for
+the three peer-lifecycle bugs the partition/heal scenarios flushed out:
+
+  * the SyncService Status-polled every peer every tick even when synced,
+    draining the host-keyed RPC rate-limit buckets until post-heal dials
+    were refused;
+  * a range-sync batch failing on its FIRST block's unknown parent
+    indicted (and eventually banned) peers honestly serving a competing
+    fork — now it backtracks to the finalized boundary instead;
+  * block lookups capped rotation at `lookup_max_attempts` even with more
+    connected peers, so post-heal fork roots held only by the other half
+    were never fetched.
+
+Full-fleet scenarios (10 nodes) and the remaining fault regimes are
+`slow`-marked.
+"""
+
+import time
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.metrics import REGISTRY
+from lighthouse_tpu.network import NetworkService, SyncConfig
+from lighthouse_tpu.network.sync import SyncService
+from lighthouse_tpu.network.sync.block_lookups import BlockLookups
+from lighthouse_tpu.testing.testnet import (
+    ChainHealthOracle,
+    FaultPlane,
+    Testnet,
+    run_eclipse_scenario,
+    run_equivocation_scenario,
+    run_gossip_flood_scenario,
+    run_late_delivery_scenario,
+    run_partition_heal_scenario,
+    run_smoke_scenario,
+    scenario_seed,
+)
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+
+@pytest.fixture(autouse=True)
+def _restore_bls_backend():
+    """Scenario nodes boot through ClientBuilder, which sets the global
+    BLS backend — restore whatever the surrounding suite had."""
+    prev = bls.backend_name()
+    yield
+    bls.set_backend(prev)
+
+
+def _spec():
+    return replace(minimal_spec(), altair_fork_epoch=0)
+
+
+def _counter(name, **labels):
+    return REGISTRY.counter(name).value(**labels)
+
+
+# -- fault plane unit surface --------------------------------------------------
+
+
+def test_fault_plane_verbs_and_components():
+    plane = FaultPlane()
+    for i, name in enumerate(["a", "b", "c", "d"]):
+        plane.register(name, "127.0.0.1", 9000 + i)
+    assert plane.node_for("127.0.0.1", 9001) == "b"
+    assert plane.edge("a", "b") == 0.0
+    plane.partition(["a", "b"], ["c", "d"])
+    assert plane.edge("a", "c") is None
+    assert plane.edge("c", "a") is None
+    assert plane.edge("a", "b") == 0.0
+    assert not plane.dial_allowed("a", "d")
+    assert plane.dial_allowed("a", "b")
+    assert plane.components(["a", "b", "c", "d"]) in (
+        [{"a", "b"}, {"c", "d"}],
+        [{"c", "d"}, {"a", "b"}],
+    )
+    plane.delay("a", "b", 0.5)
+    assert plane.edge("a", "b") == 0.5
+    assert plane.edge("b", "a") == 0.5  # symmetric by default
+    plane.mute("c", "d")
+    assert plane.edge("c", "d") is None
+    assert plane.dial_allowed("c", "d")  # muted, not blocked
+    plane.lie_status("d", 64)
+    assert plane.status_extra("d") == 64
+    plane.heal()
+    assert plane.edge("a", "c") == 0.0
+    assert plane.status_extra("d") == 0
+    assert plane.components(["a", "b", "c", "d"]) == [{"a", "b", "c", "d"}]
+
+
+def test_scenario_seed_env_override(monkeypatch):
+    assert scenario_seed(42) == 42
+    monkeypatch.setenv("LIGHTHOUSE_TPU_SCENARIO_SEED", "777")
+    assert scenario_seed(42) == 777
+
+
+# -- /lighthouse/health chain block -------------------------------------------
+
+
+def test_health_chain_block_served_per_node():
+    """Every node's Beacon API serves its OWN chain vitals in one health
+    GET — the oracle's single-endpoint contract."""
+    net = Testnet.create(_spec(), E, node_count=2, validator_count=8, seed=9)
+    try:
+        oracle = ChainHealthOracle(net)
+        net.run_until_slot(E.SLOTS_PER_EPOCH + 1, start_slot=1)
+        for node in net.nodes:
+            c = oracle.chain_block(node)
+            assert c["head_slot"] == int(node.chain.head_state.slot)
+            assert c["head_root"] == "0x" + node.chain.head_root.hex()
+            assert c["clock_slot"] == E.SLOTS_PER_EPOCH + 1
+            assert c["head_lag_slots"] in (0, 1)
+            assert c["finalized_epoch"] == int(
+                node.chain.finalized_checkpoint.epoch
+            )
+            assert c["finalized_distance_epochs"] >= 0
+            assert c["reorgs_total"] == node.chain.reorgs_total
+            assert c["max_reorg_depth"] == node.chain.max_reorg_depth
+            # altair chain one epoch in: participation is a real rate
+            assert 0.0 <= c["participation_prev_epoch"] <= 1.0
+    finally:
+        net.shutdown()
+
+
+def test_health_without_chain_omits_chain_block():
+    """The standalone MetricsServer path (no chain bound) keeps serving
+    process health — just without the per-node block."""
+    from lighthouse_tpu.metrics.server import serve_lighthouse_path
+    import json
+
+    code, _ctype, body = serve_lighthouse_path("/lighthouse/health")
+    assert code == 200
+    data = json.loads(body)["data"]
+    assert "chain" not in data
+    assert "uptime_seconds" in data
+
+
+# -- tier-1 scenario smoke -----------------------------------------------------
+
+
+def test_scenario_smoke_partition_heal_converges():
+    """The tentpole contract at its smallest shape: 3 real nodes run
+    healthy, fork under a partition, heal, and converge to one head with
+    finality advancing — asserted through each node's health endpoint."""
+    report = run_smoke_scenario(_spec(), E)
+    assert report["recovery_slots"] <= 6 * E.SLOTS_PER_EPOCH
+    assert report["recovery_to_finality_s"] > 0
+
+
+def test_eclipse_victim_recovers_when_honest_peers_readmitted():
+    report = run_eclipse_scenario(_spec(), E)
+    # the victim was genuinely dark (behind AND on its own fork) ...
+    assert report["victim_gap_slots"] > 0
+    # ... and rejoined the fleet head once honest peers returned
+    assert report["recovery_slots"] <= 6 * E.SLOTS_PER_EPOCH
+
+
+def test_equivocating_proposer_slashed_exactly_once():
+    """gossip → SLASHER_PROCESS lane → emission, end to end: the observer
+    node (the only one running a slasher) must turn the double proposal
+    into exactly ONE ProposerSlashing."""
+    report = run_equivocation_scenario(_spec(), E)
+    assert report["slashings_emitted"] == 1
+    assert report["slasher_cycles"] >= 1
+
+
+# -- directed regressions: SyncService status-poll discipline ------------------
+
+
+class _StubClock:
+    def __init__(self, slot=0):
+        self.slot = slot
+
+    def now(self):
+        return self.slot
+
+
+class _StubHead:
+    def __init__(self, slot=0):
+        self.slot = slot
+
+
+class _StubChain:
+    def __init__(self):
+        self.slot_clock = _StubClock()
+        self.head_state = _StubHead()
+
+
+class _StubService:
+    def __init__(self):
+        self.chain = _StubChain()
+        self.port = 0
+
+
+class _StubPeer:
+    def __init__(self, pid):
+        self.peer_id = pid
+
+
+class _StubManager:
+    def __init__(self):
+        self.service = _StubService()
+        self.polls = 0
+        self.candidates = []
+
+    def poll_sync_candidates(self):
+        self.polls += 1
+        return self.candidates, self.candidates, 0
+
+    def _range_sync(self, serving, target):
+        return 0
+
+
+def test_sync_service_skips_status_polls_when_synced():
+    """A node at its head must NOT Status-poll every tick: co-hosted
+    nodes share host-keyed rate-limit buckets, and the per-tick storm
+    starved post-heal handshakes fleet-wide."""
+    mgr = _StubManager()
+    svc = SyncService(mgr, interval=0.01, status_poll_interval=5.0)
+    for _ in range(5):
+        svc._tick()
+    assert mgr.polls == 1  # the initial refresh only
+    # falling behind the clock re-enables eager polling immediately
+    mgr.service.chain.slot_clock.slot = 10
+    for _ in range(3):
+        svc._tick()
+    assert mgr.polls == 4
+
+
+def test_sync_service_backoff_resets_on_new_serving_peer():
+    """Failures earned against one peer set must not throttle a NEW
+    serving peer (partition heal, eclipse lifted)."""
+    mgr = _StubManager()
+    svc = SyncService(mgr, interval=0.01)
+    svc._consecutive_failures = 5
+    svc._last_serving_ids = {"old-peer"}
+    mgr.candidates = [_StubPeer("new-peer")]
+    before = _counter(
+        "sync_service_backoff_resets_total", reason="new_serving_peer"
+    )
+    svc._tick()
+    assert svc._consecutive_failures == 0
+    assert (
+        _counter("sync_service_backoff_resets_total", reason="new_serving_peer")
+        == before + 1
+    )
+
+
+def test_sync_service_peer_connected_wakes_sleeping_loop():
+    """A fresh connection cuts the backoff sleep short instead of serving
+    out a sentence earned against dead peers."""
+    mgr = _StubManager()
+    svc = SyncService(mgr, interval=30.0)  # would sleep 30 s per cycle
+    svc.start()
+    try:
+        assert mgr.polls == 0
+        svc.on_peer_connected()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and mgr.polls == 0:
+            time.sleep(0.01)
+        assert mgr.polls >= 1
+    finally:
+        svc.stop()
+
+
+# -- directed regression: range sync backtracks on a competing fork ------------
+
+
+def _harness(slots=0):
+    bls.set_backend("fake_crypto")
+    h = BeaconChainHarness(_spec(), E, validator_count=16)
+    if slots:
+        h.extend_chain(slots, attest=False)
+    return h
+
+
+def test_range_sync_backtracks_on_competing_fork():
+    """A node whose head sits on a fork of the serving peer's chain must
+    import the competing chain from the finalized boundary — NOT retry
+    the impossible window and ban the honest peer."""
+    a = _harness()
+    a.extend_chain(4, attest=False)
+    b = _harness()
+    na = NetworkService(a.chain, heartbeat_interval=None).start()
+    nb = NetworkService(
+        b.chain,
+        heartbeat_interval=None,
+        sync_config=SyncConfig(backoff_base_s=0.01, backoff_max_s=0.05),
+    ).start()
+    try:
+        # shared prefix: b imports a's first 4 blocks
+        for blk in na.blocks_by_range(1, 4):
+            b.slot_clock.set_slot(int(blk.message.slot))
+            b.chain.process_block(blk)
+        # diverge: a extends its canonical chain; b builds its own block
+        # at a slot a skipped differently (distinct chains above slot 4)
+        a.extend_chain(12, attest=False)  # a: slots 1..16
+        b.add_block_at_slot(6)  # b: fork block at 6 on the shared prefix
+        assert b.chain.head_root != a.chain.head_root
+        b.slot_clock.set_slot(16)
+        peer = nb.connect("127.0.0.1", na.port)
+        backtracks = _counter("sync_fork_backtracks_total")
+        nb.sync.sync_with(peer)
+        assert _counter("sync_fork_backtracks_total") == backtracks + 1
+        # the competing chain (a's head) landed in b's fork choice
+        assert b.chain.fork_choice.contains_block(a.chain.head_root)
+        # and the honest peer is still connected, not downscored to a ban
+        alive = nb.peers.get(peer.peer_id)
+        assert alive is not None and not alive.banned
+        assert alive.score > -40
+    finally:
+        na.stop()
+        nb.stop()
+
+
+# -- directed regression: lookup rotation spans the whole pool -----------------
+
+
+class _LookupCtx:
+    """select_peer in list order; only the honest peer serves the root."""
+
+    def __init__(self, honest_id, block):
+        self.honest_id = honest_id
+        self.block = block
+
+    def select_peer(self, pool, exclude=(), strikes=None):
+        for p in pool:
+            if p.peer_id not in exclude:
+                return p
+        return None
+
+    def blocks_by_root(self, peer, roots):
+        return [self.block] if peer.peer_id == self.honest_id else []
+
+
+class _LookupPeers:
+    def __init__(self, peers):
+        self._peers = peers
+
+    def peers(self):
+        return list(self._peers)
+
+    def report(self, peer_id, delta):
+        pass
+
+
+class _LookupService:
+    def __init__(self, peers):
+        self.peers = _LookupPeers(peers)
+
+
+def test_failed_lookup_root_negative_cached():
+    """A root the whole pool just failed to serve must not re-trigger a
+    full-pool sweep per spam message — the negative cache bounds the
+    amplification the whole-pool rotation would otherwise hand an
+    unknown-root flood."""
+    import lighthouse_tpu.network.sync.block_lookups as bl
+
+    a = _harness(slots=1)
+    peers = [_StubPeer(f"p{i}") for i in range(4)]
+    ctx = _LookupCtx("nobody", None)  # every peer answers empty
+    lookups = BlockLookups(
+        _LookupService(peers), ctx, SyncConfig(lookup_max_attempts=3)
+    )
+    lookups.service.chain = a.chain
+    lookups.service.reprocess = None
+    lookups.service.processor = None
+    garbage = b"\x66" * 32
+    assert lookups._spawn(garbage, None, kind="single") is True
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and lookups.inflight_count():
+        time.sleep(0.01)
+    assert garbage in lookups._recent_failures
+    # within the TTL the same root refuses to spawn another sweep
+    assert lookups._spawn(garbage, None, kind="single") is False
+    # an expired entry retries (a heal may have brought serving peers)
+    lookups._recent_failures[garbage] -= bl.LOOKUP_NEGATIVE_TTL_S + 1
+    assert lookups._spawn(garbage, None, kind="single") is True
+    while lookups.inflight_count():
+        time.sleep(0.01)
+    # ... and a FRESH PEER voids the verdict immediately: "nobody had
+    # it" only binds the pool that said so
+    assert lookups._spawn(garbage, None, kind="single") is False
+    lookups.peer_connected()
+    assert lookups._spawn(garbage, None, kind="single") is True
+
+
+def test_lookup_rotation_spans_whole_pool_past_empty_answers():
+    """Six connected peers, only the LAST holds the block: the fetch must
+    rotate past every honest 'don't have it' instead of stopping at the
+    3-attempt budget (post-heal fork roots live on the other half)."""
+    a = _harness(slots=1)
+    head_root = a.chain.head_root
+    block = a.chain._blocks_by_root[head_root]
+    peers = [_StubPeer(f"p{i}") for i in range(6)]
+    ctx = _LookupCtx("p5", block)
+    lookups = BlockLookups(
+        _LookupService(peers), ctx, SyncConfig(lookup_max_attempts=3)
+    )
+    got = lookups._fetch_root(head_root)
+    assert got is not None
+    assert got.message.hash_tree_root() == head_root
+
+
+# -- full-fleet scenarios (slow) -----------------------------------------------
+
+
+@pytest.mark.slow
+def test_partition_heal_six_node_fleet():
+    report = run_partition_heal_scenario(_spec(), E)
+    assert report["max_reorg_depth"] >= 1  # competing forks really built
+    assert report["recovery_slots"] <= 6 * E.SLOTS_PER_EPOCH
+
+
+@pytest.mark.slow
+def test_partition_heal_ten_node_fleet():
+    """The full-fleet regime: 10 real nodes, uneven halves, competing
+    forks, convergence + finality after heal."""
+    report = run_partition_heal_scenario(
+        _spec(), E, node_count=10, validator_count=50, seed=11
+    )
+    assert report["max_reorg_depth"] >= 1
+    assert report["recovery_to_finality_s"] > 0
+
+
+@pytest.mark.slow
+def test_late_delivery_regime():
+    report = run_late_delivery_scenario(_spec(), E)
+    assert report["recovery_slots"] <= 6 * E.SLOTS_PER_EPOCH
+
+
+@pytest.mark.slow
+def test_gossip_flood_sheds_and_finalizes():
+    report = run_gossip_flood_scenario(_spec(), E)
+    assert report["flood_sent"] > 0
+    assert any(v > 0 for v in report["shed"].values())
+    assert min(report["finalized"]) >= 1
