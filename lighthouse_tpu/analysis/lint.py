@@ -98,6 +98,15 @@ _U64_PRODUCER_CALLS = {
 _RAW_OPS = (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv)
 _OP_GLYPH = {ast.Add: "+", ast.Sub: "-", ast.Mult: "*", ast.FloorDiv: "//"}
 
+# das/-scoped additions (PR 16): sidecar `.index` reads and the column/
+# point index producers are uint64-lane quantities in the PeerDAS spec.
+# Scoped to das/ only — `.index` is far too generic a name to taint
+# globally (list.index(), validator registries, ...), and the FR field
+# arithmetic that dominates das/erasure.py is bigint-mod-p math that must
+# NOT be pushed through the u64 checked helpers.
+_DAS_U64_ATTRS = {"index"}
+_DAS_U64_PRODUCER_CALLS = {"cell_point_index", "column_subnet"}
+
 # -- cow-aliasing vocabulary -------------------------------------------------
 
 _VIEW_PRODUCER_CALLS = {"load_array", "committee_array"}
@@ -178,6 +187,7 @@ _STATE_TRANSITION_CALLS = {
     "process_attester_slashing",
     "process_sync_committee_message",
     "process_blob_sidecars",
+    "process_data_column_sidecars",
     "per_block_processing",
 }
 
@@ -309,8 +319,15 @@ def _call_name(call: ast.Call) -> str | None:
     return None
 
 
-def _is_u64_source(node: ast.AST, tainted: set[str]) -> bool:
-    if isinstance(node, ast.Attribute) and node.attr in _U64_ATTRS:
+def _is_u64_source(
+    node: ast.AST,
+    tainted: set[str],
+    extra_attrs: frozenset = frozenset(),
+    extra_producers: frozenset = frozenset(),
+) -> bool:
+    if isinstance(node, ast.Attribute) and (
+        node.attr in _U64_ATTRS or node.attr in extra_attrs
+    ):
         return True
     if isinstance(node, ast.Subscript):
         base = node.value
@@ -318,7 +335,10 @@ def _is_u64_source(node: ast.AST, tainted: set[str]) -> bool:
             return True
         if isinstance(base, ast.Name) and base.id in tainted:
             return True
-    if isinstance(node, ast.Call) and _call_name(node) in _U64_PRODUCER_CALLS:
+    if isinstance(node, ast.Call) and (
+        _call_name(node) in _U64_PRODUCER_CALLS
+        or _call_name(node) in extra_producers
+    ):
         return True
     if isinstance(node, ast.Name) and node.id in tainted:
         return True
@@ -370,12 +390,25 @@ def _check_safe_arith(tree: ast.Module, path: str) -> list[Violation]:
     # epoch sweeps use. slasher/ joined with the columnar span subsystem
     # (PR 13): span distances and epoch arithmetic are uint-lane
     # quantities (the retained reference.py carries an allow-file).
+    # das/ joined with the PeerDAS subsystem (PR 16), with its own vocab:
+    # sidecar indices and column/point derivations are the uint lanes
+    # there (the FR field math is bigint-mod-p and stays out of scope).
+    das_scoped = "lighthouse_tpu/das" in p
     if (
         "state_processing" not in p
         and "fork_choice" not in p
         and "slasher" not in p
+        and not das_scoped
     ):
         return []
+    extra_attrs = frozenset(_DAS_U64_ATTRS) if das_scoped else frozenset()
+    extra_producers = (
+        frozenset(_DAS_U64_PRODUCER_CALLS) if das_scoped else frozenset()
+    )
+
+    def is_source(node, tainted):
+        return _is_u64_source(node, tainted, extra_attrs, extra_producers)
+
     out: list[Violation] = []
     for _scope, body in _function_scopes(tree):
         tainted: set[str] = set()
@@ -385,13 +418,13 @@ def _check_safe_arith(tree: ast.Module, path: str) -> list[Violation]:
                 if isinstance(node, ast.Assign) and isinstance(
                     node.value, ast.AST
                 ):
-                    if _is_u64_source(node.value, tainted):
+                    if is_source(node.value, tainted):
                         for t in node.targets:
                             if isinstance(t, ast.Name):
                                 tainted.add(t.id)
         for node in _walk_scope(body):
             if isinstance(node, ast.BinOp) and isinstance(node.op, _RAW_OPS):
-                if _is_u64_source(node.left, tainted) or _is_u64_source(
+                if is_source(node.left, tainted) or is_source(
                     node.right, tainted
                 ):
                     glyph = _OP_GLYPH[type(node.op)]
@@ -409,7 +442,7 @@ def _check_safe_arith(tree: ast.Module, path: str) -> list[Violation]:
             elif isinstance(node, ast.AugAssign) and isinstance(
                 node.op, _RAW_OPS
             ):
-                if _is_u64_source(node.target, tainted) or _is_u64_source(
+                if is_source(node.target, tainted) or is_source(
                     node.value, tainted
                 ):
                     glyph = _OP_GLYPH[type(node.op)]

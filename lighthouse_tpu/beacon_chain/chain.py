@@ -529,6 +529,7 @@ class BeaconChain:
         # commitment-carrying blocks need all sidecars KZG-verified first.
         commitments = getattr(block.body, "blob_kzg_commitments", None)
         imported_blobs = None
+        imported_columns = None
         if commitments and not self.block_within_da_window(
             block.slot, current_slot
         ):
@@ -536,19 +537,28 @@ class BeaconChain:
             # the spec imports such blocks without the DA gate
             commitments = None
         if commitments:
-            from .data_availability import AvailabilityCheckError
+            from .data_availability import (
+                AvailabilityCheckError,
+                MissingComponentsError,
+            )
 
             try:
                 avail = self.data_availability_checker.put_block(
                     block_root, signed_block, slot=current_slot
                 )
+            except MissingComponentsError as e:
+                # IGNORE class: nothing proven invalid, the block is
+                # staged — this forwarder must not be penalized
+                raise BlobsUnavailableError(f"data availability: {e}") from e
             except AvailabilityCheckError as e:
                 raise BlockError(f"data availability: {e}") from e
             if not avail.available:
                 raise BlobsUnavailableError(
-                    "blobs unavailable: feed sidecars via process_blob_sidecars"
+                    "blobs unavailable: feed sidecars via "
+                    "process_blob_sidecars / process_data_column_sidecars"
                 )
             imported_blobs = avail.blobs
+            imported_columns = avail.columns
 
         def _milestone(name, _root=block_root, _slot=block.slot):
             self.block_times_cache.stamp(name, _root, _slot, time.monotonic())
@@ -621,6 +631,10 @@ class BeaconChain:
             # verified sidecars persist with the block so the node can
             # serve BlobSidecarsByRange/Root for the DA window
             self.store.put_blob_sidecars(block_root, imported_blobs)
+        if imported_columns:
+            # column route: persist the verified (or reconstructed-to-full)
+            # column set for DataColumnsByRange/Root serving
+            self.store.put_data_column_sidecars(block_root, imported_columns)
         self._states[block_root] = state
         self._blocks_by_root[block_root] = signed_block
         self.block_times_cache.set_imported(
@@ -783,20 +797,33 @@ class BeaconChain:
                 # pruned fork: drop entirely (incl. any staged sidecars)
                 self._blocks_by_root.pop(root, None)
                 self.store.delete_blob_sidecars(root)
+                self.store.delete_data_column_sidecars(root)
         if migrated:
             self.store.migrate_to_cold(finalized_slot, migrated)
-        # blob retention: drop sidecars of pruned forks and of canonical
-        # blocks aged out of the DA window (deneb p2p
-        # MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS)
+        # DA retention: drop sidecars/columns of canonical blocks aged out
+        # of the window (deneb p2p MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS).
+        # The slot-keyed store index walks ONLY the expired slots — the
+        # former full blob_sidecar_entries() scan re-read every key's slot
+        # prefix on every prune cycle (ISSUE 16 satellite); orphaned fork
+        # entries are deleted eagerly in the fork-drop loop above.
         da_cutoff = finalized_slot - self.da_window_slots()
-        for root, sc_slot in self.store.blob_sidecar_entries():
-            # age check from the slot prefix; orphan check via cheap
-            # existence lookups (no decode on either path)
-            if sc_slot < da_cutoff or (
-                root not in self._blocks_by_root
-                and not self.store.block_exists(root)
+        for root, _sc_slot in self.store.blob_sidecar_entries_before(da_cutoff):
+            self.store.delete_blob_sidecars(root)
+        for root, _sc_slot in self.store.data_column_entries_before(da_cutoff):
+            self.store.delete_data_column_sidecars(root)
+        # orphan backstop: entries whose block never imported (staged for a
+        # fork that lost). The entry walk is the in-memory index — the DB is
+        # only consulted for roots already absent from the block map.
+        for root, _sc_slot in self.store.blob_sidecar_entries():
+            if root not in self._blocks_by_root and not self.store.block_exists(
+                root
             ):
                 self.store.delete_blob_sidecars(root)
+        for root, _sc_slot in self.store.data_column_entries():
+            if root not in self._blocks_by_root and not self.store.block_exists(
+                root
+            ):
+                self.store.delete_data_column_sidecars(root)
         self.observed_attesters.prune(finalized.epoch)
         self.observed_aggregators.prune(finalized.epoch)
         self.observed_block_producers.prune(finalized_slot)  # keyed by slot
@@ -904,29 +931,135 @@ class BeaconChain:
         verify_header_signature=False: its blocks may be ahead of our
         head (unknown proposers / later forks) and the segment batch
         verifies the block signatures itself."""
-        from .data_availability import AvailabilityCheckError
+        from .data_availability import (
+            AvailabilityCheckError,
+            MissingComponentsError,
+        )
 
-        if verify_header_signature:
-            for sc in sidecars:
-                header = getattr(sc, "signed_block_header", None)
-                if header is None:
-                    continue
-                try:
-                    ok = sigsets.block_header_signature_set(
-                        self.head_state, header, self.spec, self.E
-                    ).verify()
-                except (IndexError, KeyError, ValueError) as e:
-                    raise BlockError(
-                        f"blob sidecar header malformed: {e}"
-                    ) from e
-                if not ok:
-                    raise BlockError("blob sidecar header signature invalid")
+        self._verify_sidecar_headers(sidecars, verify_header_signature, "blob")
         try:
             return self.data_availability_checker.put_blobs(
                 block_root, sidecars, slot=self.slot_clock.now()
             )
+        except MissingComponentsError as e:
+            # IGNORE class (spec): nothing proven invalid — the forwarder
+            # must not be penalized for locally missing prerequisites
+            raise BlobsUnavailableError(f"blob sidecars pending: {e}") from e
         except AvailabilityCheckError as e:
             raise BlockError(f"blob sidecars rejected: {e}") from e
+
+    def _verify_sidecar_headers(
+        self, sidecars: list, verify_header_signature: bool, kind: str
+    ) -> None:
+        """Gossip-path proposer-signature gate shared by blob and column
+        sidecars — without it anyone could flood the pending dict with
+        self-consistent KZG data under fabricated headers."""
+        if not verify_header_signature:
+            return
+        for sc in sidecars:
+            header = getattr(sc, "signed_block_header", None)
+            if header is None:
+                continue
+            try:
+                ok = sigsets.block_header_signature_set(
+                    self.head_state, header, self.spec, self.E
+                ).verify()
+            except (IndexError, KeyError, ValueError) as e:
+                raise BlockError(f"{kind} sidecar header malformed: {e}") from e
+            if not ok:
+                raise BlockError(f"{kind} sidecar header signature invalid")
+
+    def process_data_column_sidecars(
+        self, block_root: bytes, sidecars: list, verify_header_signature=True
+    ):
+        """KZG-verify and stage data-column sidecars for a block (PeerDAS
+        gossip/RPC columns path → data_availability_checker.put_columns).
+        Error taxonomy mirrors process_blob_sidecars: proven-invalid cells
+        raise BlockError (gossip REJECT); locally missing prerequisites
+        raise BlobsUnavailableError (gossip IGNORE)."""
+        from .data_availability import (
+            AvailabilityCheckError,
+            MissingComponentsError,
+        )
+
+        self._verify_sidecar_headers(sidecars, verify_header_signature, "column")
+        try:
+            return self.data_availability_checker.put_columns(
+                block_root, sidecars, slot=self.slot_clock.now()
+            )
+        except MissingComponentsError as e:
+            raise BlobsUnavailableError(f"data columns pending: {e}") from e
+        except AvailabilityCheckError as e:
+            raise BlockError(f"data column sidecars rejected: {e}") from e
+
+    def process_segment_blob_sidecars(self, by_root: dict) -> dict:
+        """Segment-wide blob KZG coalescing (range sync): ONE
+        verify_blob_kzg_proof_batch RLC across every sidecar of every
+        block in the segment, instead of one pairing batch per block. On
+        failure the per-BLOCK groups are bisected so the offending block
+        is attributed exactly (log2(blocks) extra batch calls, only on the
+        failure path). Returns {block_root: None | AvailabilityCheckError};
+        clean groups are staged in the DA checker pre-verified."""
+        from .data_availability import (
+            AvailabilityCheckError,
+            InvalidComponentsError,
+        )
+
+        results: dict = {}
+        groups = []
+        for root, scs in by_root.items():
+            try:
+                # structural + binding checks now; KZG deferred to the
+                # segment-wide batch below
+                self.data_availability_checker.verify_blob_sidecars(
+                    scs, root, skip_kzg=True
+                )
+                groups.append((root, list(scs)))
+            except AvailabilityCheckError as e:
+                results[root] = e
+        bad_roots = self._bisect_segment_kzg(groups)
+        now = self.slot_clock.now()
+        for root, scs in groups:
+            if root in bad_roots:
+                results[root] = InvalidComponentsError(
+                    "blob KZG batch verification failed"
+                )
+                continue
+            try:
+                self.data_availability_checker.put_blobs(
+                    root, scs, slot=now, pre_verified=True
+                )
+                results[root] = None
+            except AvailabilityCheckError as e:
+                results[root] = e
+        return results
+
+    def _bisect_segment_kzg(self, groups: list) -> set:
+        """Roots whose sidecars fail KZG, found by batch-then-bisect: the
+        whole segment is one RLC when clean (the common case); a failing
+        batch splits on block boundaries until each failure is pinned."""
+        kzg = self.data_availability_checker.kzg
+        if not groups or kzg is None:
+            return set()
+
+        def batch_ok(gs) -> bool:
+            blobs, commitments, proofs = [], [], []
+            for _root, scs in gs:
+                for sc in scs:
+                    blobs.append(bytes(sc.blob))
+                    commitments.append(bytes(sc.kzg_commitment))
+                    proofs.append(bytes(sc.kzg_proof))
+            return kzg.verify_blob_kzg_proof_batch(blobs, commitments, proofs)
+
+        def bisect(gs) -> set:
+            if not gs or batch_ok(gs):
+                return set()
+            if len(gs) == 1:
+                return {gs[0][0]}
+            mid = len(gs) // 2
+            return bisect(gs[:mid]) | bisect(gs[mid:])
+
+        return bisect(groups)
 
     def process_attestation_batch(self, attestations) -> list:
         # root span of the gossip-attestation hot path (OBSERVABILITY.md

@@ -44,21 +44,24 @@ class WorkType(enum.IntEnum):
     RPC_BLOCK = 1
     GOSSIP_BLOCK = 2
     GOSSIP_BLOB_SIDECAR = 3
-    GOSSIP_AGGREGATE = 4
-    GOSSIP_ATTESTATION = 5
-    UNKNOWN_BLOCK_ATTESTATION = 6
-    UNKNOWN_BLOCK_AGGREGATE = 7
-    GOSSIP_SYNC_COMMITTEE = 8
-    API_REQUEST = 9
-    GOSSIP_VOLUNTARY_EXIT = 10
-    GOSSIP_PROPOSER_SLASHING = 11
-    GOSSIP_ATTESTER_SLASHING = 12
-    BACKFILL_SYNC = 13
+    #: PeerDAS column sidecars rank with blob sidecars: both feed the DA
+    #: gate that unblocks held block imports
+    GOSSIP_DATA_COLUMN_SIDECAR = 4
+    GOSSIP_AGGREGATE = 5
+    GOSSIP_ATTESTATION = 6
+    UNKNOWN_BLOCK_ATTESTATION = 7
+    UNKNOWN_BLOCK_AGGREGATE = 8
+    GOSSIP_SYNC_COMMITTEE = 9
+    API_REQUEST = 10
+    GOSSIP_VOLUNTARY_EXIT = 11
+    GOSSIP_PROPOSER_SLASHING = 12
+    GOSSIP_ATTESTER_SLASHING = 13
+    BACKFILL_SYNC = 14
     #: slasher epoch detection (slasher/service): the whole cycle is
     #: deferrable background work — lowest priority, so a storm drains
     #: every protocol lane before detection takes a worker, and detection
     #: NEVER runs inline on a gossip reader thread (queue-discipline)
-    SLASHER_PROCESS = 14
+    SLASHER_PROCESS = 15
 
 
 _QUEUE_BOUNDS = {
@@ -66,6 +69,7 @@ _QUEUE_BOUNDS = {
     WorkType.RPC_BLOCK: 64,
     WorkType.GOSSIP_BLOCK: 1024,
     WorkType.GOSSIP_BLOB_SIDECAR: 1024,
+    WorkType.GOSSIP_DATA_COLUMN_SIDECAR: 1024,
     WorkType.GOSSIP_AGGREGATE: 4096,
     WorkType.GOSSIP_ATTESTATION: 16384,
     WorkType.UNKNOWN_BLOCK_ATTESTATION: 8192,

@@ -201,7 +201,10 @@ class ClientBuilder:
             from ..crypto.kzg import Kzg, TrustedSetup
 
             setup = (
-                TrustedSetup.insecure_dev()
+                # sized to the preset so tiny-blob test specs (testnet DAS
+                # scenarios) get a matching dev domain; the default preset
+                # keeps the standard 4096
+                TrustedSetup.insecure_dev(cfg.E.FIELD_ELEMENTS_PER_BLOB)
                 if cfg.kzg == "dev"
                 else TrustedSetup.default()
             )

@@ -41,6 +41,7 @@ ROOT_SPAN_NAMES = (
     "api_request",
     "fork_choice_get_head",
     "slasher_process",
+    "da_verify",
 )
 
 _RING_SIZE = int(os.environ.get("LIGHTHOUSE_TPU_TRACE_RING", "256"))

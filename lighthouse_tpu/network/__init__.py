@@ -643,6 +643,7 @@ class NetworkService:
         sync_config=None,
         processor_workers: int = 2,
         sync_service_interval: float | None = None,
+        node_id: bytes | None = None,
     ):
         self.chain = chain
         self.spec = chain.spec
@@ -693,6 +694,27 @@ class NetworkService:
                 bootnodes=list(bootnodes),
             )
 
+        # PeerDAS custody + sampling duty (das/): custody columns derive
+        # from a stable node id — supplied by the scenario/fleet layer, or
+        # defaulted from the listen port (deterministic per node). The DA
+        # checker learns the custody set so its column route can complete.
+        import hashlib as _hashlib
+
+        from ..das import SamplingEngine
+        from ..das.custody import column_subnet as _column_subnet
+
+        self._column_subnet = _column_subnet
+        if node_id is None:
+            node_id = _hashlib.sha256(
+                b"lighthouse-tpu-node" + self.port.to_bytes(8, "little")
+            ).digest()
+        self.node_id = bytes(node_id)
+        self.sampling = SamplingEngine(self.node_id, chain.E)
+        chain.data_availability_checker.set_custody(self.sampling.custody)
+        #: roots whose sampling verdict has already been recorded (the
+        #: slot-tick retry must not re-query peers for a settled root)
+        self._sampled_roots: set = set()
+
         digest = self.fork_digest()
         self.topic_block = M.gossip_topic(digest, M.TOPIC_BEACON_BLOCK)
         # one topic per attestation subnet; a full node stays subscribed
@@ -717,6 +739,14 @@ class NetworkService:
             digest, M.TOPIC_SYNC_COMMITTEE
         )
         self.topic_blob_sidecar = M.gossip_topic(digest, M.TOPIC_BLOB_SIDECAR)
+        # one topic per data-column subnet (peerdas p2p): a full node
+        # subscribes to all of them — it relays every column and its
+        # custody subset is always fed — while custody tracking stays the
+        # SamplingEngine's concern
+        self.data_column_topics = {
+            i: M.gossip_topic(digest, M.data_column_subnet_topic_name(i))
+            for i in range(chain.E.DATA_COLUMN_SIDECAR_SUBNET_COUNT)
+        }
         # scoring parameters are keyed by the node's actual topic strings,
         # so the router is built only once the topics exist
         # (gossipsub_scoring_parameters.rs shape)
@@ -731,6 +761,7 @@ class NetworkService:
                     self.topic_attester_slashing,
                     self.topic_sync_committee,
                     self.topic_blob_sidecar,
+                    *self.data_column_topics.values(),
                 ],
             )
         if gossip_thresholds is None:
@@ -797,6 +828,15 @@ class NetworkService:
             self._decode_gossip_blob_sidecar,
             self._process_gossip_blob_sidecar,
         )
+        # all column subnets share one lane and one underlying handler
+        # function, same as the attestation subnets above
+        for topic in self.data_column_topics.values():
+            self.gossip.subscribe_queued(
+                topic,
+                WorkType.GOSSIP_DATA_COLUMN_SIDECAR,
+                self._decode_gossip_data_column_sidecar,
+                self._process_gossip_data_column_sidecar,
+            )
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -840,6 +880,9 @@ class NetworkService:
         self._last_tick_slot = slot
         self.reprocess.slot_started(slot, self.processor)
         self.reprocess.expire(slot)
+        # per-slot PeerDAS sampling duty: retry staged blocks still
+        # lacking a positive verdict (das/sampling.py)
+        self._sample_pending()
         # slasher epoch detection rides its own lowest-priority processor
         # lane (WorkType.SLASHER_PROCESS) — queued here, never run on this
         # heartbeat thread; the service's epoch claim keeps this and the
@@ -1266,35 +1309,137 @@ class NetworkService:
         seen-cache, so nothing else will retry it. An unknown PARENT for
         the completed block starts a lookup instead of downscoring the
         sidecar's forwarder (it did nothing wrong)."""
-        from ..beacon_chain.chain import BlockError
-
         block_root = sc.signed_block_header.message.hash_tree_root()
         avail = self.chain.process_blob_sidecars(block_root, [sc])
-        if avail.available and not self.chain.fork_choice.contains_block(
+        self._import_completed_block(block_root, avail)
+
+    def _import_completed_block(self, block_root: bytes, avail):
+        """Import a block whose DA components just became complete (blob
+        and column sidecar handlers + the sampling verdict path). An
+        unknown PARENT starts a lookup; any other import failure is
+        Ignore, never a penalty — the component's forwarder could not
+        have known (the component itself verified), and the block's own
+        gossip path penalizes whoever forwarded an invalid block."""
+        from ..beacon_chain.chain import BlockError
+
+        if not avail.available or self.chain.fork_choice.contains_block(
             block_root
         ):
-            try:
-                self.chain.process_block(avail.block)
-            except BlockError as e:
-                if "parent unknown" in str(e):
-                    log.info(
-                        "completed block has unknown parent; starting lookup",
-                        root=block_root.hex()[:12],
-                    )
-                    self.sync.on_unknown_parent_block(avail.block)
-                    raise GossipIgnore("unknown parent") from e
-                # the completed BLOCK failed import — the sidecar's
-                # forwarder could not have known (the sidecar itself
-                # KZG/header-verified): Ignore, never a penalty. The
-                # block's own gossip path penalizes whoever forwarded
-                # the invalid block.
+            return
+        try:
+            self.chain.process_block(avail.block)
+        except BlockError as e:
+            if "parent unknown" in str(e):
                 log.info(
-                    "completed block failed import",
+                    "completed block has unknown parent; starting lookup",
                     root=block_root.hex()[:12],
-                    error=str(e)[:120],
                 )
-                raise GossipIgnore(str(e)) from e
-            self.reprocess.block_imported(block_root, self.processor)
+                self.sync.on_unknown_parent_block(avail.block)
+                raise GossipIgnore("unknown parent") from e
+            log.info(
+                "completed block failed import",
+                root=block_root.hex()[:12],
+                error=str(e)[:120],
+            )
+            raise GossipIgnore(str(e)) from e
+        self.reprocess.block_imported(block_root, self.processor)
+
+    def _decode_gossip_data_column_sidecar(self, data: bytes):
+        return self.chain.types.DataColumnSidecar.deserialize(data)
+
+    def _process_gossip_data_column_sidecar(self, sc):
+        """Verify (header binding + batched cell KZG) and stage a gossiped
+        data column; then run the sampling duty for its block if still
+        unsettled — a column arriving means its block is circulating, so
+        peers plausibly hold the sample columns by now. Availability may
+        complete here via any column route (custody+sampling or >=50%
+        reconstruction) and imports the staged block exactly as a
+        completing blob does."""
+        from ..beacon_chain.chain import BlobsUnavailableError
+
+        block_root = sc.signed_block_header.message.hash_tree_root()
+        try:
+            avail = self.chain.process_data_column_sidecars(block_root, [sc])
+        except BlobsUnavailableError as e:
+            # IGNORE class: locally missing prerequisites (e.g. no KZG
+            # engine) — never the forwarder's fault
+            raise GossipIgnore(str(e)) from e
+        self._maybe_sample(block_root)
+        if not avail.available:
+            avail = self.chain.data_availability_checker.check_availability(
+                block_root
+            )
+        self._import_completed_block(block_root, avail)
+
+    # -- PeerDAS sampling duty (das/sampling.py) --------------------------------
+
+    def _maybe_sample(self, block_root: bytes):
+        """One sampling attempt per root: query the engine's selected
+        non-custody columns from peers (DataColumnSidecarsByRoot), stage
+        whatever verified, and record the verdict with the DA checker."""
+        checker = self.chain.data_availability_checker
+        if (
+            block_root in self._sampled_roots
+            or not checker.sampling_pending(block_root)
+        ):
+            return
+        self._sampled_roots.add(block_root)
+        have = set(checker.staged_columns(block_root))
+        ok, fetched = self.sampling.sample(
+            block_root, have, lambda col: self._fetch_column(block_root, col)
+        )
+        if fetched:
+            try:
+                self.chain.process_data_column_sidecars(
+                    block_root, fetched, verify_header_signature=False
+                )
+            except ValueError:
+                # a peer served a non-verifying sample: counts as a miss
+                ok = False
+        checker.set_sampling_result(
+            block_root, ok, slot=self.chain.slot_clock.now()
+        )
+
+    def _fetch_column(self, block_root: bytes, column: int):
+        """First peer that serves (and roots) the requested column wins."""
+        ident = M.BlobIdentifier(block_root=block_root, index=int(column))
+        decode = self.chain.types.DataColumnSidecar.deserialize
+        for peer in self.peers.peers():
+            try:
+                scs = peer.client.data_column_sidecars_by_root([ident], decode)
+            except (RpcError, OSError, ValueError):
+                continue
+            for sc in scs:
+                if (
+                    int(sc.index) == int(column)
+                    and sc.signed_block_header.message.hash_tree_root()
+                    == block_root
+                ):
+                    return sc
+        return None
+
+    def _sample_pending(self):
+        """Slot-tick retry: staged blocks without a positive sampling
+        verdict (their columns raced ahead of the block, no peer held the
+        samples yet, or an earlier attempt missed) get one fresh attempt
+        per slot edge."""
+        checker = self.chain.data_availability_checker
+        for root in checker.pending_roots():
+            if not checker.staged_columns(root):
+                continue  # no column traffic for this block: blob route
+            self._sampled_roots.discard(root)  # one fresh attempt per edge
+            try:
+                self._maybe_sample(root)
+                self._import_completed_block(
+                    root, checker.check_availability(root)
+                )
+            except (ValueError, GossipIgnore):
+                # AvailabilityCheckError / ignorable import outcome:
+                # nothing to relay or penalize on a timer tick
+                continue
+        # settled roots that left the pending dict no longer need their
+        # dedup marker (bound the set across a long run)
+        self._sampled_roots &= set(checker.pending_roots(with_block=False))
 
     # -- publishing -------------------------------------------------------------
 
@@ -1339,6 +1484,12 @@ class NetworkService:
 
     def publish_blob_sidecar(self, sidecar):
         self.gossip.publish(self.topic_blob_sidecar, sidecar.serialize())
+
+    def publish_data_column_sidecar(self, sidecar):
+        """Publish a column on its own subnet topic (column j rides
+        subnet j % DATA_COLUMN_SIDECAR_SUBNET_COUNT)."""
+        subnet = self._column_subnet(sidecar.index, self.chain.E)
+        self.gossip.publish(self.data_column_topics[subnet], sidecar.serialize())
 
     # -- RPC server data providers ----------------------------------------------
 
@@ -1395,3 +1546,39 @@ class NetworkService:
                 if int(sc.index) == int(bid.index):
                     out.append(sc)
         return out
+
+    def data_column_sidecars_by_range(
+        self, start_slot: int, count: int, columns: list
+    ):
+        """Column sidecars for canonical blocks in [start, start+count),
+        filtered to the requested column indices (peerdas p2p
+        DataColumnSidecarsByRange)."""
+        wanted = {int(c) for c in columns}
+        out = []
+        for root, _signed in self._blocks_by_range_with_roots(start_slot, count):
+            for sc in self._columns_for_root(root):
+                if not wanted or int(sc.index) in wanted:
+                    out.append(sc)
+        return out
+
+    def data_column_sidecars_by_root(self, column_ids: list):
+        out = []
+        by_root: dict[bytes, list] = {}
+        for cid in column_ids:
+            root = bytes(cid.block_root)
+            if root not in by_root:
+                by_root[root] = self._columns_for_root(root)
+            for sc in by_root[root]:
+                if int(sc.index) == int(cid.index):
+                    out.append(sc)
+        return out
+
+    def _columns_for_root(self, root: bytes) -> list:
+        """Persisted columns for imported blocks; staged (verified but
+        not-yet-imported) columns otherwise — sampling peers must be able
+        to serve within the block's own slot, before import lands."""
+        stored = self.chain.store.get_data_column_sidecars(root)
+        if stored:
+            return stored
+        staged = self.chain.data_availability_checker.staged_columns(root)
+        return [staged[j] for j in sorted(staged)]

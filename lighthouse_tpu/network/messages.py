@@ -62,6 +62,22 @@ class BlobsByRootRequest(Container):
     blob_ids: List[BlobIdentifier, 1024]
 
 
+class DataColumnsByRangeRequest(Container):
+    """DataColumnSidecarsByRange (EIP-7594 p2p): a slot range plus the
+    requester's wanted column indices (custody set or sampling targets)."""
+
+    start_slot: uint64
+    count: uint64
+    columns: List[uint64, 128]
+
+
+class DataColumnsByRootRequest(Container):
+    """DataColumnSidecarsByRoot: identifiers reuse DataColumnIdentifier's
+    (block_root, index) shape via BlobIdentifier — same SSZ layout."""
+
+    column_ids: List[BlobIdentifier, 1024]
+
+
 GOODBYE_CLIENT_SHUTDOWN = 1
 GOODBYE_IRRELEVANT_NETWORK = 2
 GOODBYE_FAULT = 3
@@ -93,6 +109,12 @@ PROTO_BLOBS_BY_RANGE = (
     "/eth2/beacon_chain/req/blob_sidecars_by_range/1/ssz_snappy"
 )
 PROTO_BLOBS_BY_ROOT = "/eth2/beacon_chain/req/blob_sidecars_by_root/1/ssz_snappy"
+PROTO_DATA_COLUMNS_BY_RANGE = (
+    "/eth2/beacon_chain/req/data_column_sidecars_by_range/1/ssz_snappy"
+)
+PROTO_DATA_COLUMNS_BY_ROOT = (
+    "/eth2/beacon_chain/req/data_column_sidecars_by_root/1/ssz_snappy"
+)
 PROTO_GOSSIP = "/lighthouse_tpu/gossip/1"  # persistent pub/sub stream
 PROTO_MUX = "/lighthouse_tpu/mux/1"  # yamux-style multiplexed connection
 
@@ -120,3 +142,8 @@ TOPIC_PROPOSER_SLASHING = "proposer_slashing"
 TOPIC_ATTESTER_SLASHING = "attester_slashing"
 TOPIC_SYNC_COMMITTEE = "sync_committee_0"
 TOPIC_BLOB_SIDECAR = "blob_sidecar_0"
+TOPIC_DATA_COLUMN_SIDECAR = "data_column_sidecar_0"  # subnet-0 (back compat)
+
+
+def data_column_subnet_topic_name(subnet_id: int) -> str:
+    return f"data_column_sidecar_{int(subnet_id)}"

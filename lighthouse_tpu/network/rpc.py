@@ -29,6 +29,7 @@ RESP_RATE_LIMITED = 3  # p2p-interface ResourceUnavailable-class refusal
 MAX_PAYLOAD = 1 << 22  # 4 MiB cap (gossip_max_size class bound)
 MAX_REQUEST_BLOCKS = 1024
 MAX_REQUEST_BLOB_SIDECARS = 768  # deneb p2p: 128 blocks × 6 blobs
+MAX_REQUEST_DATA_COLUMN_SIDECARS = 16384  # peerdas p2p: 128 blocks × 128 cols
 
 #: protocol id → short method name for per-method latency metrics (the
 #: `proto.split("/")[-3]` component the request counters already use)
@@ -43,6 +44,8 @@ _RPC_METHODS = {
         M.PROTO_BLOCKS_BY_ROOT,
         M.PROTO_BLOBS_BY_RANGE,
         M.PROTO_BLOBS_BY_ROOT,
+        M.PROTO_DATA_COLUMNS_BY_RANGE,
+        M.PROTO_DATA_COLUMNS_BY_ROOT,
     )
 }
 #: request-latency buckets: local-loopback pings are sub-ms, a clamped
@@ -357,6 +360,31 @@ class RpcServer:
             if self._limited(sock, proto, max(1, len(blob_ids))):
                 return
             self._stream(sock, node.blob_sidecars_by_root, blob_ids)
+        elif proto == M.PROTO_DATA_COLUMNS_BY_RANGE:
+            req = M.DataColumnsByRangeRequest.deserialize(_recv_block(sock))
+            # column responses are bounded by sidecar count, not block
+            # count: clamp the slot span so count × wanted-columns fits
+            # the cap (the spec lets servers respond with fewer)
+            columns = sorted({int(c) for c in req.columns})
+            n_cols = max(1, len(columns))
+            count = min(
+                int(req.count), MAX_REQUEST_DATA_COLUMN_SIDECARS // n_cols
+            )
+            if self._limited(sock, proto, count * n_cols):
+                return
+            self._stream(
+                sock,
+                node.data_column_sidecars_by_range,
+                req.start_slot,
+                count,
+                columns,
+            )
+        elif proto == M.PROTO_DATA_COLUMNS_BY_ROOT:
+            req = M.DataColumnsByRootRequest.deserialize(_recv_block(sock))
+            column_ids = list(req.column_ids)[:MAX_REQUEST_DATA_COLUMN_SIDECARS]
+            if self._limited(sock, proto, max(1, len(column_ids))):
+                return
+            self._stream(sock, node.data_column_sidecars_by_root, column_ids)
         else:
             self._respond(sock, RESP_INVALID_REQUEST, b"")
 
@@ -514,4 +542,20 @@ class RpcClient:
         req = M.BlobsByRootRequest(blob_ids=blob_ids)
         return self._stream_blocks(
             M.PROTO_BLOBS_BY_ROOT, req.serialize(), decode_sidecar
+        )
+
+    def data_column_sidecars_by_range(
+        self, start_slot: int, count: int, columns: list, decode_sidecar
+    ):
+        req = M.DataColumnsByRangeRequest(
+            start_slot=start_slot, count=count, columns=list(columns)
+        )
+        return self._stream_blocks(
+            M.PROTO_DATA_COLUMNS_BY_RANGE, req.serialize(), decode_sidecar
+        )
+
+    def data_column_sidecars_by_root(self, column_ids: list, decode_sidecar):
+        req = M.DataColumnsByRootRequest(column_ids=column_ids)
+        return self._stream_blocks(
+            M.PROTO_DATA_COLUMNS_BY_ROOT, req.serialize(), decode_sidecar
         )

@@ -136,10 +136,16 @@ class SyncNetworkContext:
         for sc in sidecars:
             r = sc.signed_block_header.message.hash_tree_root()
             by_root.setdefault(r, []).append(sc)
-        for root, scs in by_root.items():
-            try:
-                chain.process_blob_sidecars(
-                    root, scs, verify_header_signature=False
-                )
-            except Exception:  # noqa: BLE001 — bad sidecar: penalize, move on
-                self.service.peers.report(peer.peer_id, SCORE_INVALID_MESSAGE)
+        # segment-wide coalesced KZG: one RLC batch across every block's
+        # sidecars (bisected per block only on failure) instead of one
+        # pairing batch per block — proven-invalid groups penalize the
+        # peer; merely-missing components don't (IGNORE class)
+        from ...beacon_chain.data_availability import InvalidComponentsError
+
+        try:
+            results = chain.process_segment_blob_sidecars(by_root)
+        except Exception:  # noqa: BLE001 — unexpected: penalize, move on
+            self.service.peers.report(peer.peer_id, SCORE_INVALID_MESSAGE)
+            return
+        if any(isinstance(e, InvalidComponentsError) for e in results.values()):
+            self.service.peers.report(peer.peer_id, SCORE_INVALID_MESSAGE)

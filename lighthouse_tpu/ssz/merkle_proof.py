@@ -123,6 +123,33 @@ def verify_blob_inclusion_proof(sidecar, E) -> bool:
     return verify_merkle_proof(leaf, branch, depth, index, body_root)
 
 
+def compute_commitments_inclusion_proof(body, E) -> list[bytes]:
+    """Branch proving the WHOLE `blob_kzg_commitments` list root against
+    the body root (the PeerDAS DataColumnSidecar proof: one branch for
+    the list, not one per commitment — the column carries every
+    commitment anyway, so only the list's membership needs proving)."""
+    _root, branch, _fidx = container_field_proof(body, "blob_kzg_commitments")
+    assert len(branch) == E.KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH
+    return branch
+
+
+def verify_commitments_inclusion_proof(sidecar, E) -> bool:
+    """Verify sidecar.kzg_commitments_inclusion_proof: the sidecar's own
+    commitments list, re-rooted, must prove into the header's body_root."""
+    from ..types.containers import build_types
+
+    t = build_types(E)
+    body_root = bytes(sidecar.signed_block_header.message.body_root)
+    list_t = t.BeaconBlockBodyDeneb._fields["blob_kzg_commitments"]
+    leaf = list_t.hash_tree_root_of(sidecar.kzg_commitments)
+    branch = [bytes(b) for b in sidecar.kzg_commitments_inclusion_proof]
+    depth = E.KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH
+    if len(branch) != depth:
+        return False
+    index = list(t.BeaconBlockBodyDeneb._fields).index("blob_kzg_commitments")
+    return verify_merkle_proof(leaf, branch, depth, index, body_root)
+
+
 def build_blob_sidecars(signed_block, blobs: list[bytes], kzg, E) -> list:
     """Full BlobSidecar containers for a block's blobs (proofs + header) —
     what the block producer hands to gossip (beacon_chain blob packing)."""

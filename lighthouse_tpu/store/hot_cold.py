@@ -53,6 +53,11 @@ class HotColdDB:
         self.cold = cold if cold is not None else MemoryStore()
         self.types = types  # SimpleNamespace from build_types, for SSZ codecs
         self._split_slot = 0
+        # slot-keyed DA retention index: DBColumn -> {slot: set(block_root)},
+        # built lazily from the stored slot prefixes on first expiry query,
+        # maintained by every put/delete after that — pruning walks only
+        # expired slots instead of rescanning every entry (ISSUE 16)
+        self._da_index: dict = {}
         self._check_schema_version()
 
     def _check_schema_version(self):
@@ -132,19 +137,113 @@ class HotColdDB:
         for sc in sidecars:
             data = sc.serialize()
             parts.append(len(data).to_bytes(4, "little") + data)
-        self.hot.put(DBColumn.BLOB_SIDECARS, block_root, b"".join(parts))
+        self._da_put(DBColumn.BLOB_SIDECARS, block_root, slot, b"".join(parts))
 
     def delete_blob_sidecars(self, block_root: bytes):
-        self.hot.delete(DBColumn.BLOB_SIDECARS, block_root)
+        self._da_delete(DBColumn.BLOB_SIDECARS, block_root)
 
     def blob_sidecar_entries(self) -> list[tuple[bytes, int]]:
         """(block_root, slot) per stored sidecar set — slot from the
         8-byte prefix, no SSZ decode."""
+        return self._da_entries(DBColumn.BLOB_SIDECARS)
+
+    def blob_sidecar_entries_before(self, cutoff_slot: int) -> list[tuple[bytes, int]]:
+        """(block_root, slot) for sidecar sets with slot < cutoff — via the
+        slot index, touching only expired entries (never a full scan)."""
+        return self._da_entries_before(DBColumn.BLOB_SIDECARS, cutoff_slot)
+
+    # -- slot-keyed DA retention index (shared by blobs and data columns) --
+
+    def _da_index_for(self, column: DBColumn) -> dict:
+        idx = self._da_index.get(column)
+        if idx is None:
+            # one-time build from the stored slot prefixes (pre-existing
+            # DBs); every subsequent put/delete maintains it incrementally
+            idx = {}
+            for root in self.hot.keys(column):
+                prefix = self.hot.get_prefix(column, root, 8)
+                if prefix and len(prefix) == 8:
+                    slot = int.from_bytes(prefix, "little")
+                    idx.setdefault(slot, set()).add(root)
+            self._da_index[column] = idx
+        return idx
+
+    def _da_put(self, column: DBColumn, block_root: bytes, slot: int, value: bytes):
+        idx = self._da_index_for(column)
+        old = self.hot.get_prefix(column, block_root, 8)
+        if old and len(old) == 8:
+            old_slot = int.from_bytes(old, "little")
+            if old_slot != slot and old_slot in idx:
+                idx[old_slot].discard(block_root)
+        self.hot.put(column, block_root, value)
+        idx.setdefault(int(slot), set()).add(block_root)
+
+    def _da_delete(self, column: DBColumn, block_root: bytes):
+        idx = self._da_index_for(column)
+        prefix = self.hot.get_prefix(column, block_root, 8)
+        if prefix and len(prefix) == 8:
+            slot = int.from_bytes(prefix, "little")
+            roots = idx.get(slot)
+            if roots is not None:
+                roots.discard(block_root)
+                if not roots:
+                    del idx[slot]
+        self.hot.delete(column, block_root)
+
+    def _da_entries(self, column: DBColumn) -> list[tuple[bytes, int]]:
+        return [
+            (root, slot)
+            for slot, roots in self._da_index_for(column).items()
+            for root in roots
+        ]
+
+    def _da_entries_before(
+        self, column: DBColumn, cutoff_slot: int
+    ) -> list[tuple[bytes, int]]:
+        idx = self._da_index_for(column)
+        return [
+            (root, slot)
+            for slot in sorted(s for s in idx if s < cutoff_slot)
+            for root in sorted(idx[slot])
+        ]
+
+    # -- data column sidecars (PeerDAS; served via DataColumnsByRange/Root) -
+
+    def put_data_column_sidecars(self, block_root: bytes, sidecars: list):
+        """A block's verified DataColumnSidecars under its root — same
+        8-byte slot prefix + length-prefixed concat layout as blobs, and
+        the same slot-indexed retention."""
+        if not sidecars:
+            return
+        slot = int(sidecars[0].signed_block_header.message.slot)
+        parts = [slot.to_bytes(8, "little")]
+        for sc in sidecars:
+            data = sc.serialize()
+            parts.append(len(data).to_bytes(4, "little") + data)
+        self._da_put(DBColumn.DATA_COLUMNS, block_root, slot, b"".join(parts))
+
+    def delete_data_column_sidecars(self, block_root: bytes):
+        self._da_delete(DBColumn.DATA_COLUMNS, block_root)
+
+    def data_column_entries(self) -> list[tuple[bytes, int]]:
+        return self._da_entries(DBColumn.DATA_COLUMNS)
+
+    def data_column_entries_before(self, cutoff_slot: int) -> list[tuple[bytes, int]]:
+        return self._da_entries_before(DBColumn.DATA_COLUMNS, cutoff_slot)
+
+    def get_data_column_sidecars(self, block_root: bytes) -> list:
+        data = self.hot.get(DBColumn.DATA_COLUMNS, block_root)
+        if data is None:
+            return []
         out = []
-        for root in self.hot.keys(DBColumn.BLOB_SIDECARS):
-            prefix = self.hot.get_prefix(DBColumn.BLOB_SIDECARS, root, 8)
-            if prefix and len(prefix) == 8:
-                out.append((root, int.from_bytes(prefix, "little")))
+        pos = 8  # skip slot prefix
+        while pos < len(data):
+            n = int.from_bytes(data[pos : pos + 4], "little")
+            pos += 4
+            out.append(
+                self.types.DataColumnSidecar.deserialize(data[pos : pos + n])
+            )
+            pos += n
         return out
 
     def get_blob_sidecars(self, block_root: bytes) -> list:

@@ -26,6 +26,7 @@ class DBColumn(str, Enum):
     ETH1_CACHE = "etc"
     HOT_STATE_SUMMARY = "hss"
     BLOB_SIDECARS = "blb"
+    DATA_COLUMNS = "dcl"
     SLASHER_ATTESTATION = "sat"
     SLASHER_INDEXED = "sai"
     SLASHER_BLOCK = "sbk"

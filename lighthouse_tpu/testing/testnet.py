@@ -22,7 +22,14 @@ and on every dial, so a scenario can
   * `delay` edges past the attestation propagation window;
   * `flood` gossip lanes from attacker nodes (no VC, pure spam);
   * make a proposer `equivocate`, which must surface through the PR 13
-    slasher's SLASHER_PROCESS lane as exactly one ProposerSlashing.
+    slasher's SLASHER_PROCESS lane as exactly one ProposerSlashing;
+  * make a blob proposer `withhold_columns`: its node suppresses a
+    fraction of the data-column sidecars at publish AND refuses to serve
+    them over the column RPCs — the PeerDAS data-withholding attack.
+    Below 50% kept, honest nodes must refuse the head (sampling fails,
+    reconstruction impossible) while the chain finalizes past it; at
+    >=50% kept, reconstruction promotes the staged columns to full
+    availability and the block imports fleet-wide.
 
 The **oracle** (`ChainHealthOracle`) asserts invariants from each node's
 /lighthouse/health `chain` block — participation rate, head lag vs the
@@ -36,6 +43,7 @@ Every scenario takes an explicit RNG seed; a failing run logs it and
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import random
@@ -58,13 +66,22 @@ from ..state_processing.accessors import (
     get_domain,
 )
 from ..types.chain_spec import Domain, compute_signing_root
+from ..types.eth_spec import MinimalEthSpec
 from ..utils.logging import get_logger
 
 log = get_logger("lighthouse_tpu.testnet")
 
 TESTNET_GENESIS_TIME = 1_600_000_000
 
-FAULT_KINDS = ("partition", "heal", "eclipse", "delay", "flood", "equivocation")
+FAULT_KINDS = (
+    "partition",
+    "heal",
+    "eclipse",
+    "delay",
+    "flood",
+    "equivocation",
+    "withhold",
+)
 # eager registration: the scenario_smoke tier-1 run and dashboards read
 # these series before the first fault is ever injected
 for _kind in FAULT_KINDS:
@@ -126,6 +143,7 @@ class FaultPlane:
         self._muted: set[tuple[str, str]] = set()
         self._delays: dict[tuple[str, str], float] = {}
         self._lies: dict[str, int] = {}
+        self._withheld: dict[str, frozenset[int]] = {}
 
     # -- registry ---------------------------------------------------------
 
@@ -154,6 +172,12 @@ class FaultPlane:
     def status_extra(self, node_id: str) -> int:
         with self._lock:
             return self._lies.get(node_id, 0)
+
+    def withheld_columns(self, node_id: str) -> frozenset[int]:
+        """Column indices `node_id` is scripted to withhold (empty set =
+        honest). Consulted on every column publish and column-RPC serve."""
+        with self._lock:
+            return self._withheld.get(node_id, frozenset())
 
     # -- verbs ------------------------------------------------------------
 
@@ -187,6 +211,30 @@ class FaultPlane:
             else:
                 self._lies.pop(node_id, None)
 
+    def withhold_columns(
+        self,
+        node_id: str,
+        fraction: float,
+        total_columns: int,
+        rng: random.Random | None = None,
+    ) -> tuple[int, ...]:
+        """Script `node_id` to withhold `fraction` of the data-column
+        index space: a seeded draw when `rng` is given (scenario replay
+        rides the scenario seed), the top indices otherwise. Returns the
+        withheld set; fraction 0 clears it."""
+        total = int(total_columns)
+        k = min(total, round(float(fraction) * total))
+        if rng is not None:
+            withheld = frozenset(rng.sample(range(total), k))
+        else:
+            withheld = frozenset(range(total - k, total))
+        with self._lock:
+            if withheld:
+                self._withheld[node_id] = withheld
+            else:
+                self._withheld.pop(node_id, None)
+        return tuple(sorted(withheld))
+
     def heal(self):
         """Clear every scripted fault (the registry survives)."""
         with self._lock:
@@ -194,6 +242,7 @@ class FaultPlane:
             self._muted.clear()
             self._delays.clear()
             self._lies.clear()
+            self._withheld.clear()
 
     # -- topology ---------------------------------------------------------
 
@@ -229,7 +278,13 @@ class TestnetNetworkService(NetworkService):
 
     def __init__(self, chain, *, plane: FaultPlane, node_id: str, **kwargs):
         self.plane = plane
-        self.node_id = node_id
+        # the plane keys edges by the fleet NAME; NetworkService.node_id
+        # is the 32-byte custody-derivation id, so the name maps to bytes
+        # deterministically (same name -> same custody columns on replay)
+        self.plane_id = node_id
+        kwargs.setdefault(
+            "node_id", hashlib.sha256(b"testnet:" + node_id.encode()).digest()
+        )
         super().__init__(chain, **kwargs)
 
     def _peer_node(self, peer_id: str) -> str | None:
@@ -243,7 +298,7 @@ class TestnetNetworkService(NetworkService):
         dst = self._peer_node(peer_id)
         if dst is None:
             return 0.0  # unregistered peer (e.g. mid-registration): pass
-        d = self.plane.edge(self.node_id, dst)
+        d = self.plane.edge(self.plane_id, dst)
         if d is None:
             inc_counter("testnet_gossip_frames_dropped_total")
         elif d > 0:
@@ -252,15 +307,33 @@ class TestnetNetworkService(NetworkService):
 
     def connect(self, host: str, port: int):
         dst = self.plane.node_for(host, port)
-        if dst is not None and not self.plane.dial_allowed(self.node_id, dst):
+        if dst is not None and not self.plane.dial_allowed(self.plane_id, dst):
             raise RpcError(
-                f"fault plane: edge {self.node_id} -> {dst} is dark"
+                f"fault plane: edge {self.plane_id} -> {dst} is dark"
             )
         return super().connect(host, port)
 
+    # -- PeerDAS withholding (the DAS scenario's proposer-side fault): the
+    # withheld indices never leave this node, on EITHER protocol surface —
+    # suppressed at gossip publish and filtered from the column-RPC
+    # provider (which backs both ByRange and ByRoot serving)
+
+    def publish_data_column_sidecar(self, sidecar):
+        if int(sidecar.index) in self.plane.withheld_columns(self.plane_id):
+            inc_counter("testnet_gossip_frames_dropped_total")
+            return
+        super().publish_data_column_sidecar(sidecar)
+
+    def _columns_for_root(self, root: bytes) -> list:
+        cols = super()._columns_for_root(root)
+        withheld = self.plane.withheld_columns(self.plane_id)
+        if not withheld:
+            return cols
+        return [sc for sc in cols if int(sc.index) not in withheld]
+
     def local_status(self) -> M.StatusMessage:
         st = super().local_status()
-        extra = self.plane.status_extra(self.node_id)
+        extra = self.plane.status_extra(self.plane_id)
         if not extra:
             return st
         return M.StatusMessage(
@@ -321,6 +394,7 @@ class Testnet:
     plane: FaultPlane
     seed: int
     rng: random.Random
+    kzg: str = "none"
     keypairs: list = field(default_factory=list)
     nodes: list[TestnetNode] = field(default_factory=list)
     attackers: list[TestnetNode] = field(default_factory=list)
@@ -345,6 +419,7 @@ class Testnet:
         heartbeat_interval: float = 0.05,
         sync_service_interval: float | None = 0.1,
         full_mesh_max: int = 12,
+        kzg: str = "none",
     ) -> "Testnet":
         """Boot `node_count` full nodes (ClientBuilder each: chain +
         fault-planed network + Beacon API + VC over a disjoint key share)
@@ -356,7 +431,8 @@ class Testnet:
         keypairs = bls.interop_keypairs(validator_count)
         plane = FaultPlane()
         net = cls(
-            spec=spec, E=E, plane=plane, seed=seed, rng=rng, keypairs=keypairs
+            spec=spec, E=E, plane=plane, seed=seed, rng=rng, kzg=kzg,
+            keypairs=keypairs,
         )
         share = validator_count // node_count
         for i in range(node_count):
@@ -404,6 +480,7 @@ class Testnet:
             validate=not attacker,
             slasher=slasher,
             bls_backend=bls_backend,
+            kzg=self.kzg,
             http_port=0,
             network_port=0,
             manual_slot_clock=True,
@@ -668,6 +745,114 @@ class Testnet:
         )
         return proposer
 
+    def withhold_columns(self, node_name: str, fraction: float) -> tuple:
+        """Script `node_name` to withhold a seeded `fraction` of the
+        data-column index space: suppressed at gossip publish and filtered
+        from its column-RPC serving (the PeerDAS withholding attack).
+        Returns the withheld column indices. heal() clears it."""
+        inc_counter("testnet_fault_injections_total", kind="withhold")
+        withheld = self.plane.withhold_columns(
+            node_name, fraction, int(self.E.NUMBER_OF_COLUMNS), rng=self.rng
+        )
+        log.info(
+            "column withholding applied", node=node_name,
+            fraction=fraction, withheld=list(withheld), seed=self.seed,
+        )
+        return withheld
+
+    def propose_blob_block(
+        self, slot: int, node_name: str | None = None, n_blobs: int = 2
+    ) -> tuple[bytes, list]:
+        """Craft and publish `slot`'s proposal CARRYING blob commitments.
+        Block production has no blob source, so — like `equivocate`
+        hand-signs its double proposal — the DAS scenarios build the
+        sidecar-backed proposal by hand on `node_name`: produce the slot's
+        block, graft `n_blobs` seeded blob commitments into its body,
+        re-sign with the duty key, import locally via the full-column
+        route (which persists the column set for RPC serving), then
+        publish the block and its column sidecars — minus whatever the
+        fault plane says this node withholds. Returns
+        (block_root, column_sidecars). Call with the clock at `slot` and
+        INSTEAD of the slot's normal proposal (run_slot(propose=False))."""
+        from ..crypto.kzg import FR_MODULUS
+        from ..das import build_data_column_sidecars
+
+        node = self.node(node_name) if node_name else self.nodes[0]
+        chain = node.chain
+        kzg = chain.data_availability_checker.kzg
+        if kzg is None:
+            raise ScenarioFailure(
+                f"[seed={self.seed}] propose_blob_block needs "
+                "Testnet.create(..., kzg='dev')"
+            )
+        E = self.E
+        st = chain.head_state.copy()
+        while st.slot < slot:
+            per_slot_processing(st, self.spec, E)
+        proposer = get_beacon_proposer_index(st, E)
+        sk = self.keypairs[proposer].sk
+        epoch = compute_epoch_at_slot(slot, E)
+        randao_domain = get_domain(st, Domain.RANDAO, epoch, self.spec, E)
+        randao = sk.sign(
+            compute_signing_root(
+                epoch.to_bytes(8, "little").ljust(32, b"\x00"), randao_domain
+            )
+        ).to_bytes()
+        blk, _post = chain.produce_block_on_state(slot, randao)
+        blobs = [
+            b"".join(
+                self.rng.randrange(FR_MODULUS).to_bytes(32, "big")
+                for _ in range(E.FIELD_ELEMENTS_PER_BLOB)
+            )
+            for _ in range(n_blobs)
+        ]
+        blk.body.blob_kzg_commitments = [
+            kzg.blob_to_kzg_commitment(b) for b in blobs
+        ]
+        # the grafted commitments change the body root, which the header
+        # transition writes into state — recompute the state root the way
+        # produce_block_on_state does
+        from ..state_processing import (
+            BlockSignatureStrategy,
+            ConsensusContext,
+            per_block_processing,
+        )
+
+        post = st.copy()
+        ctxt = ConsensusContext(slot)
+        ctxt.set_proposer_index(proposer)
+        t = chain.types
+        tf = t.types_for_fork(t.fork_of_block(blk))
+        per_block_processing(
+            post,
+            tf.SignedBeaconBlock(message=blk),
+            self.spec,
+            E,
+            strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            ctxt=ctxt,
+            verify_block_root=False,
+        )
+        blk.state_root = post.hash_tree_root()
+        prop_domain = get_domain(
+            st, Domain.BEACON_PROPOSER, epoch, self.spec, E
+        )
+        sig = sk.sign(
+            compute_signing_root(blk.hash_tree_root(), prop_domain)
+        ).to_bytes()
+        signed = tf.SignedBeaconBlock(message=blk, signature=sig)
+        root = blk.hash_tree_root()
+        sidecars = build_data_column_sidecars(signed, blobs, kzg, E)
+        chain.process_data_column_sidecars(root, sidecars)
+        chain.process_block(signed)
+        node.network.publish_block(signed)
+        for sc in sidecars:
+            node.network.publish_data_column_sidecar(sc)
+        log.info(
+            "blob block proposed", slot=slot, node=node.name,
+            root=root.hex()[:12], blobs=n_blobs, seed=self.seed,
+        )
+        return root, sidecars
+
     # -- plane enforcement -------------------------------------------------
 
     @staticmethod
@@ -897,13 +1082,18 @@ def _run_to_convergence(
     start_slot: int,
     max_epochs: int = 6,
     min_finalized_advance: int = 1,
+    min_finalized_epoch: int = 0,
 ) -> dict:
     """Post-heal driver: keep running slots until every node shares one
     head AND finality advanced `min_finalized_advance` past the heal
-    point. Returns recovery timings for the soak bench."""
+    point (and past the absolute `min_finalized_epoch` floor, for
+    scenarios that must finalize BEYOND a specific slot — e.g. so
+    finality pruning provably covers a withheld block's epoch).
+    Returns recovery timings for the soak bench."""
     E = net.E
     S = E.SLOTS_PER_EPOCH
     fin_at_heal = max(_finalized_epochs(net))
+    fin_target = max(fin_at_heal + min_finalized_advance, min_finalized_epoch)
     t0 = time.perf_counter()
     converged_at = None
     slot = start_slot
@@ -912,10 +1102,7 @@ def _run_to_convergence(
         heads = {n.chain.head_root for n in net.nodes}
         if len(heads) == 1 and converged_at is None:
             converged_at = time.perf_counter() - t0
-        if (
-            len(heads) == 1
-            and min(_finalized_epochs(net)) >= fin_at_heal + min_finalized_advance
-        ):
+        if len(heads) == 1 and min(_finalized_epochs(net)) >= fin_target:
             return {
                 "recovery_slots": slot - start_slot + 1,
                 "head_convergence_s": round(converged_at, 3),
@@ -1310,3 +1497,173 @@ def run_equivocation_scenario(
 def _slasher_cycles() -> float:
     c = REGISTRY.counter("slasher_process_cycles_total")
     return c.value(engine="columnar") + c.value(engine="reference")
+
+
+class DasTestnetEthSpec(MinimalEthSpec):
+    """Scenario-sized PeerDAS preset: tiny blobs over a 16-column matrix
+    so a whole fleet verifies, samples, and reconstructs within a slot's
+    scenario pacing. The refusal/recovery arithmetic still holds exactly:
+    custody 2 + samples 3 against 16 columns means a sub-50% kept set can
+    NEVER satisfy custody+sampling (kept \\ custody < samples whenever
+    custody fits in 4 kept columns), and >=8 kept columns always
+    reconstructs."""
+
+    FIELD_ELEMENTS_PER_BLOB = 64
+    NUMBER_OF_COLUMNS = 16
+    DATA_COLUMN_SIDECAR_SUBNET_COUNT = 8
+    CUSTODY_REQUIREMENT = 2
+    SAMPLES_PER_SLOT = 3
+
+
+def run_column_withholding_scenario(
+    spec,
+    E,
+    *,
+    node_count: int = 3,
+    validator_count: int = 24,
+    seed: int = 6,
+    withhold_fraction: float = 0.75,
+    recover_fraction: float = 0.375,
+) -> dict:
+    """The PeerDAS data-withholding regime, both sides of the 50% line.
+
+    An adversary node proposes blob-carrying blocks but withholds a
+    fraction of the column sidecars (suppressed at publish, refused over
+    RPC). Regime 1 (`withhold_fraction` > 50%): honest nodes must REFUSE
+    the head — custody+sampling cannot complete and reconstruction is
+    impossible — while the chain keeps finalizing past the orphan.
+    Regime 2 (`recover_fraction` < 50% withheld): the kept majority
+    reconstructs the full matrix (das_reconstructions_total rises) and
+    the block imports fleet-wide. `spec` must be Deneb-from-genesis and
+    `E` a DAS-sized preset (DasTestnetEthSpec)."""
+    net = Testnet.create(
+        spec,
+        E,
+        node_count=node_count,
+        validator_count=validator_count,
+        seed=seed,
+        kzg="dev",
+    )
+    try:
+        oracle = ChainHealthOracle(net)
+        S = E.SLOTS_PER_EPOCH
+        net.run_until_slot(S, start_slot=1)
+        oracle.check(require_single_head=True, what="healthy baseline")
+        adversary = net.nodes[0].name
+        honest = [n for n in net.nodes if n.name != adversary]
+        counters = lambda: {  # noqa: E731 — three snapshots of one shape
+            "reconstructions": REGISTRY.counter(
+                "das_reconstructions_total"
+            ).value(),
+            "sampling_failures": REGISTRY.counter(
+                "das_sampling_results_total"
+            ).value(verdict="failure"),
+            "cells_batched": REGISTRY.counter(
+                "das_cells_verified_total"
+            ).value(path="batched"),
+        }
+        fin_before = max(_finalized_epochs(net))
+
+        # -- regime 1: sub-50% kept -> the fleet refuses the head
+        base = counters()
+        withheld = net.withhold_columns(adversary, withhold_fraction)
+        wh_slot = S + 1
+        net.set_slot(wh_slot)
+        withheld_root, _ = net.propose_blob_block(wh_slot, node_name=adversary)
+        net.run_slot(wh_slot, propose=False)
+        # a couple more slots: slot-edge sampling retries must keep
+        # failing, and the honest chain must keep proposing past the hole
+        net.run_until_slot(wh_slot + 2, start_slot=wh_slot + 1)
+        if not net.node(adversary).chain.fork_choice.contains_block(
+            withheld_root
+        ):
+            raise ScenarioFailure(
+                f"[seed={net.seed}] adversary refused its own blob block — "
+                "harness bug, nothing was tested"
+            )
+        for n in honest:
+            if n.chain.fork_choice.contains_block(withheld_root):
+                raise ScenarioFailure(
+                    f"[seed={net.seed}] {n.name} imported the withheld head "
+                    f"(withheld={list(withheld)})"
+                )
+        mid = counters()
+        if mid["sampling_failures"] <= base["sampling_failures"]:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] no sampling failure recorded against "
+                "the withholding proposer"
+            )
+        if mid["reconstructions"] != base["reconstructions"]:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] reconstruction fired below the 50% "
+                "threshold"
+            )
+        # finality pruning only provably drops the withheld block once the
+        # finalized slot is PAST wh_slot: drive to (wh_epoch + 1) at least
+        wh_epoch = wh_slot // S
+        refusal_recovery = _run_to_convergence(
+            net,
+            oracle,
+            start_slot=wh_slot + 3,
+            min_finalized_epoch=wh_epoch + 1,
+        )
+        oracle.check(
+            require_single_head=True,
+            min_finalized_epoch=max(fin_before + 1, wh_epoch + 1),
+            what="chain finalized past the withheld head",
+        )
+        for n in honest:
+            if n.chain.data_availability_checker.has_pending(withheld_root):
+                raise ScenarioFailure(
+                    f"[seed={net.seed}] {n.name} still stages the orphaned "
+                    "withheld block after finality pruning"
+                )
+
+        # -- regime 2: >=50% kept -> reconstruction promotes, fleet imports
+        net.heal()
+        fin_mid = max(_finalized_epochs(net))
+        net.withhold_columns(adversary, recover_fraction)
+        rec_slot = int(net.nodes[0].client.slot_clock.now()) + 1
+        net.set_slot(rec_slot)
+        recovered_root, _ = net.propose_blob_block(
+            rec_slot, node_name=adversary
+        )
+        net.run_slot(rec_slot, propose=False)
+        net.wait_for(
+            lambda: all(
+                n.chain.fork_choice.contains_block(recovered_root)
+                for n in net.nodes
+            ),
+            what="fleet-wide import of the >=50% column set via reconstruction",
+        )
+        post = counters()
+        if post["reconstructions"] <= mid["reconstructions"]:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] no reconstruction promoted the kept "
+                "column majority"
+            )
+        if post["cells_batched"] <= base["cells_batched"]:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] no cells rode the batched verification "
+                "lane"
+            )
+        net.heal()
+        recovery = _run_to_convergence(net, oracle, start_slot=rec_slot + 1)
+        oracle.check(
+            require_single_head=True,
+            min_finalized_epoch=fin_mid + 1,
+            what="post-recovery convergence",
+        )
+        return {
+            "seed": net.seed,
+            "adversary": adversary,
+            "withheld_refusal": list(withheld),
+            "sampling_failures": mid["sampling_failures"]
+            - base["sampling_failures"],
+            "reconstructions": post["reconstructions"]
+            - mid["reconstructions"],
+            "refusal_recovery_slots": refusal_recovery["recovery_slots"],
+            **recovery,
+        }
+    finally:
+        net.shutdown()

@@ -470,6 +470,28 @@ def build_types(E: type) -> SimpleNamespace:
             Bytes32, E.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH
         ]
 
+    # -- PeerDAS data columns (EIP-7594) -----------------------------------
+
+    Cell = ByteVector[E.bytes_per_cell()]
+
+    class DataColumnIdentifier(Container):
+        block_root: Bytes32
+        index: uint64
+
+    class DataColumnSidecar(Container):
+        """One column of the erasure-coded blob matrix: cell `index` of
+        EVERY blob in the block, with one KZG proof per cell and the
+        whole commitments list proven against the block body root."""
+
+        index: uint64
+        column: List[Cell, E.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+        kzg_commitments: List[KZGCommitment, E.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+        kzg_proofs: List[KZGProof, E.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+        signed_block_header: SignedBeaconBlockHeader
+        kzg_commitments_inclusion_proof: Vector[
+            Bytes32, E.KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH
+        ]
+
     # -- Electra (EIP-7251 maxeb / EIP-7002 EL withdrawals / EIP-6110
     #    deposit receipts; reference consensus/types/src/{deposit_receipt,
     #    execution_layer_withdrawal_request,pending_*}.rs)
@@ -716,6 +738,10 @@ def build_types(E: type) -> SimpleNamespace:
         SignedBeaconBlockDeneb=SignedBeaconBlockDeneb,
         BlobIdentifier=BlobIdentifier,
         BlobSidecar=BlobSidecar,
+        # peerdas
+        Cell=Cell,
+        DataColumnIdentifier=DataColumnIdentifier,
+        DataColumnSidecar=DataColumnSidecar,
         # electra
         DepositReceipt=DepositReceipt,
         ExecutionLayerWithdrawalRequest=ExecutionLayerWithdrawalRequest,

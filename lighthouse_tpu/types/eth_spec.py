@@ -87,6 +87,22 @@ class EthSpec:
     MAX_BLOBS_PER_BLOCK = 6
     KZG_COMMITMENT_INCLUSION_PROOF_DEPTH = 17
 
+    # --- PeerDAS (EIP-7594 data-availability sampling) --------------------
+    # The extended (2x erasure-coded) blob is sliced into this many cells;
+    # one DataColumnSidecar carries cell j of every blob in a block.
+    NUMBER_OF_COLUMNS = 128
+    # gossip fan-out: column j rides subnet j % SUBNET_COUNT
+    DATA_COLUMN_SIDECAR_SUBNET_COUNT = 64
+    #: columns a node must custody (and serve) as a function of node id
+    CUSTODY_REQUIREMENT = 4
+    #: random non-custody columns a node samples per slot
+    SAMPLES_PER_SLOT = 8
+    #: depth of the whole-`blob_kzg_commitments`-list proof against the
+    #: block body root (the body has <=16 fields in every preset, so the
+    #: field branch is 4 deep — contrast the per-commitment blob proof,
+    #: which adds the list element + length-mixin levels)
+    KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH = 4
+
     # --- Electra (EIP-7251/7002/6110; eth_spec.rs Electra associated
     # types in the reference) ----------------------------------------------
     PENDING_BALANCE_DEPOSITS_LIMIT = 2**27
@@ -109,6 +125,15 @@ class EthSpec:
     @classmethod
     def bytes_per_blob(cls) -> int:
         return 32 * cls.FIELD_ELEMENTS_PER_BLOB
+
+    @classmethod
+    def field_elements_per_cell(cls) -> int:
+        # the 2x-extended blob split evenly across the columns
+        return 2 * cls.FIELD_ELEMENTS_PER_BLOB // cls.NUMBER_OF_COLUMNS
+
+    @classmethod
+    def bytes_per_cell(cls) -> int:
+        return 32 * cls.field_elements_per_cell()
 
 
 class MainnetEthSpec(EthSpec):

@@ -61,6 +61,7 @@ def pytest_sessionstart(session):
     from lighthouse_tpu.testing import (  # noqa: F401 — registers testnet_*
         testnet,  # fault-injection/drop/delay counters + oracle outcomes
     )
+    import lighthouse_tpu.das  # noqa: F401 — registers das_* series + spans
 
     text = REGISTRY.expose()
     for needle in (
@@ -259,6 +260,7 @@ def pytest_sessionstart(session):
         'testnet_fault_injections_total{kind="delay"}',
         'testnet_fault_injections_total{kind="flood"}',
         'testnet_fault_injections_total{kind="equivocation"}',
+        'testnet_fault_injections_total{kind="withhold"}',
         "testnet_gossip_frames_dropped_total",
         "testnet_gossip_frames_delayed_total",
         'scenario_invariant_checks_total{result="pass"}',
@@ -266,6 +268,19 @@ def pytest_sessionstart(session):
         'sync_service_backoff_resets_total{reason="new_serving_peer"}',
         'sync_service_backoff_resets_total{reason="peer_connected"}',
         "sync_fork_backtracks_total",
+        # PR 16: the PeerDAS series — batched-vs-oracle cell verification,
+        # sampling verdicts, reconstruction promotions — must exist at
+        # zero (the da_verify bench and the withholding scenario read
+        # them eagerly), plus the da_verify stage spans
+        'das_cells_verified_total{path="batched"}',
+        'das_cells_verified_total{path="oracle"}',
+        'das_sampling_results_total{verdict="success"}',
+        'das_sampling_results_total{verdict="failure"}',
+        "das_reconstructions_total",
+        "trace_span_seconds_da_verify",
+        "trace_span_seconds_da_derive",
+        "trace_span_seconds_da_msm",
+        "trace_span_seconds_da_pairing",
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"

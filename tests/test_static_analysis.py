@@ -143,6 +143,86 @@ def test_safe_arith_span_gathers_scoped_to_slasher():
     assert lint_source(outside, OUT) == []
 
 
+# a synthetic path inside das/ — in the safe-arith scope since the
+# PeerDAS subsystem (PR 16: sidecar indices and column/point derivations
+# are uint64 lanes; the bigint-mod-p FR math stays out of the vocab)
+DAS = "lighthouse_tpu/das/_fixture.py"
+
+
+def test_safe_arith_fires_on_das_sidecar_index_arithmetic():
+    bad = (
+        "def f(sidecar, fe):\n"
+        "    return sidecar.index * fe\n"
+    )
+    assert _rules(lint_source(bad, DAS)) == ["safe-arith"]
+
+
+def test_safe_arith_fires_on_das_point_index_taint():
+    bad = (
+        "def f(commitment, j, cell):\n"
+        "    k = cell_point_index(commitment, j, cell)\n"
+        "    return k * 32\n"
+    )
+    assert _rules(lint_source(bad, DAS)) == ["safe-arith"]
+
+
+def test_safe_arith_das_clean_when_routed_through_helpers():
+    good = (
+        "from lighthouse_tpu.utils.safe_arith import safe_add, safe_mul\n"
+        "def f(sidecar, fe, k):\n"
+        "    return safe_add(safe_mul(int(sidecar.index), fe), k)\n"
+    )
+    assert lint_source(good, DAS) == []
+
+
+def test_safe_arith_das_index_vocab_scoped_to_das():
+    # `.index` is far too generic to taint globally (list.index results,
+    # registry positions, ...) — the vocab binds to das/ paths only
+    outside = (
+        "def f(sidecar, fe):\n"
+        "    return sidecar.index * fe\n"
+    )
+    assert lint_source(outside, OUT) == []
+    assert lint_source(outside, SP) == []
+
+
+def test_fork_safety_fires_on_das_shaped_worker():
+    # das/proofs.py keeps its pool workers (_msm_shard/_prove_shard)
+    # metrics-free for exactly this rule: counters are parent-side only
+    bad = (
+        "from lighthouse_tpu.metrics import inc_counter\n"
+        "def _msm_shard(task):\n"
+        "    inc_counter('das_cells_verified_total', 1.0)\n"
+        "    return task\n"
+        "def run(pool, tasks):\n"
+        "    return pool.map(_msm_shard, tasks)\n"
+    )
+    assert "fork-safety" in _rules(lint_source(bad, DAS))
+
+
+def test_queue_discipline_fires_on_column_sidecar_processing():
+    # process_data_column_sidecars joined the state-transition vocabulary:
+    # column gossip must ride a beacon_processor lane, not the reader
+    bad = (
+        "class Svc:\n"
+        "    def __init__(self):\n"
+        "        self.gossip.subscribe(self.topic_col, self._on_column)\n"
+        "    def _on_column(self, data):\n"
+        "        sc = self.decode_column(data)\n"
+        "        self.chain.process_data_column_sidecars(self.root, [sc])\n"
+    )
+    assert _rules(lint_source(bad, OUT)) == ["queue-discipline"]
+
+
+def test_metric_hygiene_fires_on_dynamic_das_series():
+    bad = (
+        "from lighthouse_tpu.metrics import inc_counter\n"
+        "def f(subnet):\n"
+        "    inc_counter(f'das_column_subnet_{subnet}_total', 1.0)\n"
+    )
+    assert _rules(lint_source(bad, DAS)) == ["metric-hygiene"]
+
+
 def test_cow_aliasing_fires_on_attesting_index_view_write_in_fork_choice():
     # the batch entry reads attesting_indices.load_array() — a frozen
     # CoW view; writing it must fire regardless of the module's path

@@ -32,8 +32,10 @@ from lighthouse_tpu.network.sync import SyncService
 from lighthouse_tpu.network.sync.block_lookups import BlockLookups
 from lighthouse_tpu.testing.testnet import (
     ChainHealthOracle,
+    DasTestnetEthSpec,
     FaultPlane,
     Testnet,
+    run_column_withholding_scenario,
     run_eclipse_scenario,
     run_equivocation_scenario,
     run_gossip_flood_scenario,
@@ -170,6 +172,51 @@ def test_equivocating_proposer_slashed_exactly_once():
     report = run_equivocation_scenario(_spec(), E)
     assert report["slashings_emitted"] == 1
     assert report["slasher_cycles"] >= 1
+
+
+def _das_spec():
+    """Deneb from genesis: blob commitments (and so the DAS column
+    pipeline) are live from slot 0."""
+    return replace(
+        minimal_spec(),
+        altair_fork_epoch=0,
+        bellatrix_fork_epoch=0,
+        capella_fork_epoch=0,
+        deneb_fork_epoch=0,
+    )
+
+
+def test_fault_plane_withhold_verb():
+    """The withhold verb's deterministic plumbing, no fleet needed."""
+    plane = FaultPlane()
+    withheld = plane.withhold_columns("n0", 0.75, 16)
+    assert len(withheld) == 12
+    assert plane.withheld_columns("n0") == frozenset(withheld)
+    assert plane.withheld_columns("n1") == frozenset()
+    # fraction 0 clears; heal clears everything
+    plane.withhold_columns("n0", 0.0, 16)
+    assert plane.withheld_columns("n0") == frozenset()
+    plane.withhold_columns("n0", 0.5, 16)
+    plane.heal()
+    assert plane.withheld_columns("n0") == frozenset()
+
+
+def test_column_withholding_refusal_then_recovery():
+    """The PeerDAS availability contract end to end on 3 real nodes: an
+    adversary withholding >50% of a blob block's columns sees every
+    honest node's sampling fail and the fleet refuse (then finalize
+    past) its head; withholding <50% leaves enough columns for honest
+    nodes to cross the reconstruction threshold and import. The custody
+    arithmetic of DasTestnetEthSpec makes both verdicts deterministic,
+    not probabilistic."""
+    report = run_column_withholding_scenario(_das_spec(), DasTestnetEthSpec)
+    assert report["sampling_failures"] >= 1
+    assert report["reconstructions"] >= 1
+    assert len(report["withheld_refusal"]) == 12  # 0.75 * 16 columns
+    assert report["recovery_slots"] <= 6 * DasTestnetEthSpec.SLOTS_PER_EPOCH
+    # the fault fleet counted the injections
+    assert _counter("testnet_fault_injections_total", kind="withhold") >= 2
+    assert _counter("das_reconstructions_total") >= 1
 
 
 # -- directed regressions: SyncService status-poll discipline ------------------
