@@ -393,11 +393,15 @@ def _check_safe_arith(tree: ast.Module, path: str) -> list[Violation]:
     # das/ joined with the PeerDAS subsystem (PR 16), with its own vocab:
     # sidecar indices and column/point derivations are the uint lanes
     # there (the FR field math is bigint-mod-p and stays out of scope).
+    # state_advance.py joined with the proposer pipeline (PR 17): the
+    # pre-advance drives per_slot_processing over the same uint64 state
+    # quantities the epoch sweeps mutate.
     das_scoped = "lighthouse_tpu/das" in p
     if (
         "state_processing" not in p
         and "fork_choice" not in p
         and "slasher" not in p
+        and "state_advance" not in p
         and not das_scoped
     ):
         return []

@@ -200,12 +200,19 @@ class BlockTimesCache:
             observe("beacon_block_imported_to_head_seconds", delay)
         self._maybe_log_late_head(block_root, e)
 
+    def _attestation_deadline(self) -> float:
+        """The clock owns the deadline definition; a clock-less cache
+        (unit tests) falls back to thirds of its own seconds_per_slot."""
+        if self.slot_clock is not None:
+            return self.slot_clock.attestation_deadline_offset
+        return self.seconds_per_slot / 3
+
     def _maybe_log_late_head(self, block_root: bytes, e: BlockTimes):
         """The reference's "block was late" diagnostic: a block that
         became head after the attestation deadline (1/3 slot) gets one
         WARNING carrying the whole per-stage breakdown."""
         off = e.slot_offsets.get("became_head")
-        if off is None or off <= self.seconds_per_slot / 3:
+        if off is None or off <= self._attestation_deadline():
             return
         # near-live blocks only: during range-sync catch-up EVERY imported
         # block is hours "late" relative to its own slot — the reference
@@ -217,7 +224,7 @@ class BlockTimesCache:
             root=block_root.hex()[:12],
             slot=e.slot,
             head_slot_offset_s=round(off, 3),
-            deadline_s=round(self.seconds_per_slot / 3, 3),
+            deadline_s=round(self._attestation_deadline(), 3),
             observed_slot_offset_s=round(
                 e.slot_offsets.get("observed", float("nan")), 3
             ),

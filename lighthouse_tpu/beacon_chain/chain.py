@@ -136,6 +136,9 @@ class BeaconChain:
         # attached by SlasherService (slasher/service feeds off the
         # chain's verified objects); None = no slasher running
         self.slasher_service = None
+        # attached by StateAdvanceTimer (state_advance.py) so the network
+        # slot tick can drive the pre-advance; None = no timer running
+        self.state_advance_timer = None
         # gossip reader threads, the VC, and sync all mutate the chain
         # concurrently; imports serialize on a loud-failure lock
         # (timeout_rw_lock.rs — starvation raises instead of deadlocking)
@@ -239,6 +242,10 @@ class BeaconChain:
                 self._states[new_head] = state
             old_head = self.head_root
             self.head_root = new_head
+            # a pre-advance keyed off the old head can never be consumed
+            # now; an entry keyed off the NEW head (re-org back, or the
+            # advance raced the import) stays
+            self.state_advance_cache.invalidate(new_head)
             self._register_head_events(old_head, new_head)
         self._register_finality_event()
         return self.head_root
@@ -460,7 +467,9 @@ class BeaconChain:
         if parent_state is None:
             raise BlockError(f"no state for parent {block.parent_root.hex()[:16]}")
         # state_advance_timer fast path: the next-slot state was pre-built
-        advanced = self.state_advance_cache.take(block.parent_root, block.slot)
+        # (`get` hands out a CoW copy and keeps the entry — the proposer
+        # and the import of its own block both hit one pre-advance)
+        advanced = self.state_advance_cache.get(block.parent_root, block.slot)
         state = advanced if advanced is not None else parent_state.copy()
         while state.slot < block.slot:
             per_slot_processing(state, self.spec, self.E)
@@ -603,8 +612,7 @@ class BeaconChain:
         # import_block: store + fork choice + head
         is_timely = (
             block.slot == current_slot
-            and self.slot_clock.seconds_into_slot()
-            < self.spec.seconds_per_slot / 3
+            and not self.slot_clock.is_past_attestation_deadline(block.slot)
         )
         with span("fork_choice_on_block"):
             self.fork_choice.on_block(
@@ -1114,6 +1122,46 @@ class BeaconChain:
 
     # ------------------------------------------------------------------ production
 
+    def get_proposer_head(self, slot: int) -> bytes:
+        """The root the proposer of `slot` should build on: the head, or
+        the head's PARENT when the head is a weak, late, single-slot
+        block the boosted re-org block would beat (spec
+        `get_proposer_head`). Fork choice owns the weight/structure
+        conditions; this layer supplies the observation-time ones —
+        whether the head arrived past the attestation deadline
+        (BlockTimesCache `observed` milestone; a locally-produced head
+        has no gossip observation and is never re-orged), and whether
+        the proposal itself is early enough in the slot to win its own
+        boost (the reference's re-org cutoff, half the deadline)."""
+        head_root = self.head_root
+        if (
+            self.slot_clock.now() == slot
+            and self.slot_clock.seconds_into_slot()
+            > self.slot_clock.attestation_deadline_offset / 2
+        ):
+            return head_root
+        times = self.block_times_cache.get(head_root)
+        observed = (
+            times.slot_offsets.get("observed") if times is not None else None
+        )
+        head_late = (
+            observed is not None
+            and observed > self.slot_clock.attestation_deadline_offset
+        )
+        if head_late:
+            # A late head usually means its slot's committee attested to
+            # the PARENT — same-slot gossip votes that sat in the
+            # fork-choice deferral queue until this slot's tick. Refresh
+            # (tick + drain + head recompute) so the re-org decision
+            # reads post-drain weights; the timely path skips the
+            # recompute and stays cheap.
+            self.recompute_head()
+            if self.head_root != head_root:
+                # the drained votes already re-orged the head on their
+                # own — build on the new head, no boost gamble needed
+                return self.head_root
+        return self.fork_choice.get_proposer_head(slot, head_root, head_late)
+
     def produce_block_on_state(
         self,
         slot: int,
@@ -1121,68 +1169,106 @@ class BeaconChain:
         graffiti: bytes = b"\x00" * 32,
         sync_aggregate_fn=None,
     ):
-        """Unsigned block on the current head (beacon_chain.rs:4137,4720):
-        advances head state, packs the op pool, computes the state root.
-        Fork-aware: builds the block variant the advanced state requires
-        (sync aggregate from `sync_aggregate_fn(state)` or empty, payload
-        with the expected withdrawals sweep). Returns (block, post_state)."""
-        from ..state_processing.bellatrix import is_merge_transition_complete
-        from ..types.chain_spec import ForkName
+        """Unsigned block for `slot` (beacon_chain.rs:4137,4720): picks
+        the build target via `get_proposer_head` (head, or its parent on
+        a late-block re-org), consumes the state_advance pre-built
+        snapshot when one matches, packs the op pool, computes the state
+        root. Fork-aware: builds the block variant the advanced state
+        requires (sync aggregate from `sync_aggregate_fn(state)` or
+        empty, payload with the expected withdrawals sweep).
 
-        state = self.head_state.copy()
-        parent_root = self.head_root
-        while state.slot < slot:
-            per_slot_processing(state, self.spec, self.E)
-        fork = self.types.fork_of_state(state)
-        tf = self.types.types_for_fork(fork)
-        proposer = get_beacon_proposer_index(state, self.E)
-        attestations = self.op_pool.get_attestations_for_block(state)
-        proposer_slashings, attester_slashings, exits = (
-            self.op_pool.get_slashings_and_exits(state)
+        Stages ride the `block_production` trace root — `advance`
+        (target choice + snapshot/advance), `pack` (op-pool), `assemble`
+        (payload + state root). If an enclosing block_production root is
+        already open (the VC wraps produce+sign in one trace), the
+        stages nest under it instead of minting a second root.
+        Returns (block, post_state)."""
+        import contextlib
+
+        from ..types.chain_spec import ForkName
+        from ..utils.tracing import current_span
+
+        enclosing = current_span()
+        root_cm = (
+            contextlib.nullcontext()
+            if enclosing is not None
+            and enclosing.root_name == "block_production"
+            else span("block_production", slot=int(slot))
         )
-        body_kwargs = dict(
-            randao_reveal=randao_reveal,
-            eth1_data=state.eth1_data,
-            graffiti=graffiti,
-            proposer_slashings=proposer_slashings,
-            attester_slashings=attester_slashings,
-            attestations=attestations,
-            voluntary_exits=exits,
-        )
-        if fork >= ForkName.ALTAIR:
-            if sync_aggregate_fn is not None:
-                body_kwargs["sync_aggregate"] = sync_aggregate_fn(state)
-            elif self.sync_message_pool is not None:
-                # messages signed at slot-1 over the parent root pack into
-                # this block (altair/validator.md inclusion rule)
-                body_kwargs["sync_aggregate"] = (
-                    self.sync_message_pool.aggregate_for(
-                        self.types, self.E, slot - 1, parent_root
-                    )
+        with root_cm:
+            with span("advance"):
+                parent_root = self.get_proposer_head(slot)
+                # state_advance_timer fast path: the next-slot state was
+                # pre-built off this exact target (CoW copy, entry kept
+                # for the import of our own block)
+                state = self.state_advance_cache.get(parent_root, slot)
+                if state is None:
+                    base = self._states.get(parent_root)
+                    if base is None:
+                        # re-org target without a cached state — build on
+                        # the head rather than fail the proposal
+                        parent_root = self.head_root
+                        base = self.head_state
+                    state = base.copy()
+                while state.slot < slot:
+                    per_slot_processing(state, self.spec, self.E)
+            fork = self.types.fork_of_state(state)
+            tf = self.types.types_for_fork(fork)
+            with span("pack"):
+                proposer = get_beacon_proposer_index(state, self.E)
+                attestations = self.op_pool.get_attestations_for_block(state)
+                proposer_slashings, attester_slashings, exits = (
+                    self.op_pool.get_slashings_and_exits(state)
                 )
-        if fork >= ForkName.BELLATRIX:
-            payload = self._produce_payload(state, fork, tf, parent_root)
-            body_kwargs["execution_payload"] = payload
-        block = tf.BeaconBlock(
-            slot=slot,
-            proposer_index=proposer,
-            parent_root=parent_root,
-            state_root=b"\x00" * 32,
-            body=tf.BeaconBlockBody(**body_kwargs),
-        )
-        post = state.copy()
-        ctxt = ConsensusContext(slot)
-        ctxt.set_proposer_index(proposer)
-        per_block_processing(
-            post,
-            tf.SignedBeaconBlock(message=block),
-            self.spec,
-            self.E,
-            strategy=BlockSignatureStrategy.NO_VERIFICATION,
-            ctxt=ctxt,
-            verify_block_root=False,
-        )
-        block.state_root = post.hash_tree_root()
+                body_kwargs = dict(
+                    randao_reveal=randao_reveal,
+                    eth1_data=state.eth1_data,
+                    graffiti=graffiti,
+                    proposer_slashings=proposer_slashings,
+                    attester_slashings=attester_slashings,
+                    attestations=attestations,
+                    voluntary_exits=exits,
+                )
+                if fork >= ForkName.ALTAIR:
+                    if sync_aggregate_fn is not None:
+                        body_kwargs["sync_aggregate"] = sync_aggregate_fn(
+                            state
+                        )
+                    elif self.sync_message_pool is not None:
+                        # messages signed at slot-1 over the build target
+                        # pack into this block (altair/validator.md
+                        # inclusion rule)
+                        body_kwargs["sync_aggregate"] = (
+                            self.sync_message_pool.aggregate_for(
+                                self.types, self.E, slot - 1, parent_root
+                            )
+                        )
+            with span("assemble"):
+                if fork >= ForkName.BELLATRIX:
+                    payload = self._produce_payload(
+                        state, fork, tf, parent_root
+                    )
+                    body_kwargs["execution_payload"] = payload
+                block = tf.BeaconBlock(
+                    slot=slot,
+                    proposer_index=proposer,
+                    parent_root=parent_root,
+                    state_root=b"\x00" * 32,
+                    body=tf.BeaconBlockBody(**body_kwargs),
+                )
+                post = state.copy()
+                ctxt = ConsensusContext(slot)
+                ctxt.set_proposer_index(proposer)
+                per_block_processing(
+                    post,
+                    tf.SignedBeaconBlock(message=block),
+                    self.spec,
+                    self.E,
+                    strategy=BlockSignatureStrategy.NO_VERIFICATION,
+                    ctxt=ctxt,
+                    verify_block_root=False,
+                )
+                block.state_root = post.hash_tree_root()
         return block, post
 
     def _produce_payload(self, state, fork, tf, parent_beacon_block_root=None):

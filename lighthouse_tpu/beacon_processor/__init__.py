@@ -57,11 +57,17 @@ class WorkType(enum.IntEnum):
     GOSSIP_PROPOSER_SLASHING = 12
     GOSSIP_ATTESTER_SLASHING = 13
     BACKFILL_SYNC = 14
+    #: next-slot state pre-advance (beacon_chain/state_advance): pure
+    #: speculation — it only saves latency if it finishes before the next
+    #: proposal, so it ranks below every protocol lane but above the
+    #: slasher (a missed pre-advance costs the proposer an epoch
+    #: transition; a deferred slasher cycle costs nothing time-critical)
+    STATE_ADVANCE = 15
     #: slasher epoch detection (slasher/service): the whole cycle is
     #: deferrable background work — lowest priority, so a storm drains
     #: every protocol lane before detection takes a worker, and detection
     #: NEVER runs inline on a gossip reader thread (queue-discipline)
-    SLASHER_PROCESS = 15
+    SLASHER_PROCESS = 16
 
 
 _QUEUE_BOUNDS = {
@@ -80,6 +86,10 @@ _QUEUE_BOUNDS = {
     WorkType.GOSSIP_PROPOSER_SLASHING: 512,
     WorkType.GOSSIP_ATTESTER_SLASHING: 512,
     WorkType.BACKFILL_SYNC: 64,
+    # one advance per slot, stale entries are useless — a tiny bound
+    # turns a stalled worker pool into drop-counted backpressure that
+    # the timer's slot-unclaim retries next tick
+    WorkType.STATE_ADVANCE: 2,
     # one epoch tick per slot; a tiny bound surfaces a stalled worker
     # pool as drop-counted backpressure instead of a silent backlog
     WorkType.SLASHER_PROCESS: 4,

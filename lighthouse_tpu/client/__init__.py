@@ -109,8 +109,19 @@ class Client:
                 ),
             )
         if self.state_advance is not None:
-            # pre-build next slot's state off the (possibly new) head
-            self.state_advance.on_slot_tick(slot)
+            # pre-build next slot's state off the (possibly new) head —
+            # on the network's STATE_ADVANCE lane when the node networks
+            # (the epoch transition never runs on this timer thread);
+            # inline on network-less nodes. The timer's slot claim dedups
+            # against the network slot tick firing for the same slot.
+            self.state_advance.on_slot_tick(
+                slot,
+                processor=(
+                    self.network.processor
+                    if self.network is not None
+                    else None
+                ),
+            )
         if self.chain.slasher_service is not None:
             # detection rides the network's SLASHER_PROCESS lane when the
             # node networks (lowest priority, worker thread); inline only

@@ -23,6 +23,22 @@ from ..types.chain_spec import GENESIS_EPOCH, ChainSpec
 
 _EMPTY_BALANCES = np.zeros(0, dtype=np.uint64)
 
+# Same-slot gossip votes held back for one slot (spec ATTESTATION_DELAY).
+# Bounded: at the target scale one slot carries ~31k aggregates, so a cap
+# well above that only trips on a flood, where shedding the tail is the
+# right call anyway.
+_MAX_DEFERRED_ATTESTATIONS = 65_536
+
+from ..metrics import REGISTRY  # noqa: E402
+
+_DEFERRED_ATTESTATIONS = REGISTRY.counter(
+    "fork_choice_deferred_attestations_total",
+    "same-slot gossip attestations held for the next tick, by outcome",
+)
+for _outcome in ("deferred", "applied", "dropped"):
+    # lint: allow(metric-hygiene) -- bounded by the literal tuple above
+    _DEFERRED_ATTESTATIONS.inc(0, outcome=_outcome)
+
 
 class ForkChoiceError(ValueError):
     pass
@@ -71,6 +87,13 @@ class ForkChoice:
         self.proto: ProtoArrayForkChoice = proto
         self.spec = spec
         self.E = E
+        # Gossip attestations for the CURRENT slot: the spec forbids
+        # counting them before slot+1 (validate_on_attestation's
+        # "from the future" rule), but dropping them starves the weights
+        # a proposer-boost re-org decision reads one slot later. Queue
+        # them here and drain at the next on_tick — fork_choice.rs
+        # queued_attestations / ATTESTATION_DELAY_SLOTS.
+        self._deferred_attestations: list = []
         # Effective balances of active validators at the justified state,
         # held as a uint64 array: the proto-array keeps a reference (its
         # "old balances" for the next delta round) instead of re-copying a
@@ -130,6 +153,26 @@ class ForkChoice:
                     self.store.unrealized_finalized_checkpoint,
                     state=None,
                 )
+        self._drain_deferred_attestations()
+
+    def _drain_deferred_attestations(self):
+        """Apply queued same-slot votes that the clock has now cleared.
+        Entries whose slot is still current stay queued (an on_tick that
+        doesn't advance the slot must not re-defer or double-count)."""
+        q = self._deferred_attestations
+        if not q:
+            return
+        cur = self.store.current_slot
+        ready = [ia for ia in q if int(ia.data.slot) < cur]
+        if not ready:
+            return
+        self._deferred_attestations = [
+            ia for ia in q if int(ia.data.slot) >= cur
+        ]
+        # per-item isolation inside the batch: a vote that went stale in
+        # the queue (e.g. pruned head) costs only itself
+        self.on_attestation_batch(ready)
+        _DEFERRED_ATTESTATIONS.inc(len(ready), outcome="applied")
 
     # ------------------------------------------------------------------ block
 
@@ -264,6 +307,8 @@ class ForkChoice:
     def on_attestation(self, indexed_attestation, is_from_block: bool = False):
         """Track latest messages (fork_choice.rs:1037)."""
         data = indexed_attestation.data
+        if self._maybe_defer(indexed_attestation, is_from_block):
+            return
         self._validate_on_attestation(data, is_from_block)
         for vi in indexed_attestation.attesting_indices:
             if vi not in self.store.equivocating_indices:
@@ -292,6 +337,9 @@ class ForkChoice:
             # vote, never the rest of the batch
             try:
                 data = ia.data
+                if self._maybe_defer(ia, is_from_block):
+                    results.append(None)
+                    continue
                 self._validate_on_attestation(data, is_from_block)
                 indices = ia.attesting_indices
                 arr = (
@@ -325,6 +373,33 @@ class ForkChoice:
             except Exception:  # noqa: BLE001 — a hard error in one
                 continue  # (root, epoch) group must not drop the others
         return results
+
+    def _maybe_defer(self, indexed_attestation, is_from_block: bool) -> bool:
+        """Queue a gossip attestation from the store's current slot — or
+        ahead of it, when the store lags the wall clock between ticks —
+        for the tick that clears it, instead of rejecting it as "from
+        the future" (fork_choice.rs queued_attestations): its committee
+        saw the head this slot, and the next slot's proposer-boost
+        re-org decision needs that weight. Upstream gossip validation
+        already bounds data.slot by the wall clock, so the queue depth
+        is one slot's traffic (plus the cap). Structural validation runs
+        NOW (with `is_from_block=True`, which skips exactly the two
+        gossip recency rules — one satisfied for any queueable slot, the
+        other the reason we defer), so the queue only ever holds votes
+        that will count. Returns True if the attestation was consumed
+        (queued or cap-shed)."""
+        if is_from_block:
+            return False
+        data = indexed_attestation.data
+        if int(data.slot) < self.store.current_slot:
+            return False
+        self._validate_on_attestation(data, is_from_block=True)
+        if len(self._deferred_attestations) >= _MAX_DEFERRED_ATTESTATIONS:
+            _DEFERRED_ATTESTATIONS.inc(outcome="dropped")
+            return True
+        self._deferred_attestations.append(indexed_attestation)
+        _DEFERRED_ATTESTATIONS.inc(outcome="deferred")
+        return True
 
     def _validate_on_attestation(self, data, is_from_block: bool):
         # Recency applies to gossip only; attestations carried in blocks may
@@ -405,6 +480,38 @@ class ForkChoice:
 
     def contains_block(self, root: bytes) -> bool:
         return self.proto.contains_block(root)
+
+    def get_proposer_head(
+        self, slot: int, head_root: bytes, head_late: bool
+    ) -> bytes:
+        """Spec `get_proposer_head` (proposer boost re-org): the root the
+        proposer of `slot` should build on — the head's PARENT when the
+        head is a weak, late, non-finality-risking, single-slot block the
+        boosted re-org block would beat; otherwise the head itself.
+
+        `head_late` is supplied by the caller (BlockTimesCache observed
+        milestone vs the attestation deadline) — lateness is an
+        observation-time property the fork-choice store never sees.
+        Weights are read as left by the last `get_head` pass; callers run
+        this right after a head recompute (every import triggers one), so
+        they are at most one pending-attestation batch stale."""
+        if not head_late:
+            return head_root
+        epoch = compute_epoch_at_slot(slot, self.E)
+        max_epochs = self.spec.reorg_max_epochs_since_finalization
+        if epoch - self.store.finalized_checkpoint.epoch > max_epochs:
+            return head_root
+        total = _total_balance(self._justified_balances)
+        committee_weight = total // self.E.SLOTS_PER_EPOCH
+        parent = self.proto.proto_array.get_proposer_head(
+            slot,
+            head_root,
+            committee_weight,
+            self.spec.reorg_head_weight_threshold,
+            self.spec.reorg_parent_weight_threshold,
+            self.E.SLOTS_PER_EPOCH,
+        )
+        return parent if parent is not None else head_root
 
 
 def _total_balance(balances) -> int:

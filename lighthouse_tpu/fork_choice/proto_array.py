@@ -440,6 +440,66 @@ class ProtoArray:
             raise ProtoArrayError("best node is not viable for head")
         return self._roots[best]
 
+    def get_proposer_head(
+        self,
+        slot: int,
+        head_root: bytes,
+        committee_weight: int,
+        head_threshold_pct: int,
+        parent_threshold_pct: int,
+        slots_per_epoch: int,
+    ) -> bytes | None:
+        """The structural/weight half of spec `get_proposer_head`
+        (proto_array_fork_choice.rs `proposer_head_info`): the parent
+        root to build on instead of `head_root`, or None to keep the
+        head. The caller (ForkChoice/chain layer) owns the remaining
+        conditions — head lateness, finalization distance, and
+        proposing-on-time — because they live outside the array.
+
+        Weights must be fresh from the last `get_head` pass; this method
+        deliberately does NOT rerun it (the boost bookkeeping in
+        apply_score_changes is stateful). If the last pass applied a
+        proposer boost to the head, it is backed out here so the head is
+        judged on attestation weight alone."""
+        hi = self.indices.get(head_root)
+        if hi is None:
+            return None
+        pi = int(self._parents[hi])
+        if pi < 0:
+            return None
+        head_slot = int(self._slots[hi])
+        parent_slot = int(self._slots[pi])
+        # single-slot re-org only: head is its parent's immediate
+        # successor and we propose the very next slot — deeper re-orgs
+        # risk splitting the vote
+        if parent_slot + 1 != head_slot or head_slot + 1 != int(slot):
+            return None
+        # shuffling stability: a re-org across an epoch boundary changes
+        # the proposer shuffling the rest of the network computed
+        if int(slot) % int(slots_per_epoch) == 0:
+            return None
+        # FFG competitiveness: the parent's chain must justify the same
+        # epoch the head's does, or the re-org block could lose the FFG
+        # race it would otherwise have won through the head
+        uje = self._uje
+        je = self._je
+        head_j = int(uje[hi]) if int(uje[hi]) >= 0 else int(je[hi])
+        parent_j = int(uje[pi]) if int(uje[pi]) >= 0 else int(je[pi])
+        if head_j != parent_j:
+            return None
+        head_weight = int(self._weights[hi])
+        if self._prev_boost_root == head_root:
+            # saturating: Python ints don't wrap, but the boost may
+            # exceed the attestation weight of a genuinely weak head
+            head_weight = max(0, head_weight - int(self._prev_boost_amount))
+        parent_weight = int(self._weights[pi])
+        cw = int(committee_weight)
+        head_weak = head_weight < cw * int(head_threshold_pct) // 100
+        parent_strong = parent_weight > cw * int(parent_threshold_pct) // 100
+        if not (head_weak and parent_strong):
+            return None
+        return self._roots[pi]
+
     # ------------------------------------------------------------------ misc
 
     def block_slot_at(self, index: int) -> int:

@@ -215,6 +215,53 @@ class ProtoArrayReference:
             raise ProtoArrayError("best node is not viable for head")
         return best.root
 
+    def get_proposer_head(
+        self,
+        slot: int,
+        head_root: bytes,
+        committee_weight: int,
+        head_threshold_pct: int,
+        parent_threshold_pct: int,
+        slots_per_epoch: int,
+    ) -> bytes | None:
+        """Scalar oracle for ProtoArray.get_proposer_head: one node at a
+        time over ProtoNode objects, no column reads. Same contract —
+        the parent root to build on, or None to keep the head; the
+        caller owns lateness/finalization/on-time conditions."""
+        hi = self.indices.get(head_root)
+        if hi is None:
+            return None
+        head = self.nodes[hi]
+        if head.parent is None:
+            return None
+        parent = self.nodes[head.parent]
+        if parent.slot + 1 != head.slot or head.slot + 1 != slot:
+            return None
+        if slot % slots_per_epoch == 0:
+            return None
+        head_j = (
+            head.unrealized_justified_epoch
+            if head.unrealized_justified_epoch is not None
+            else head.justified_epoch
+        )
+        parent_j = (
+            parent.unrealized_justified_epoch
+            if parent.unrealized_justified_epoch is not None
+            else parent.justified_epoch
+        )
+        if head_j != parent_j:
+            return None
+        head_weight = head.weight
+        if self._prev_boost_root == head_root:
+            head_weight = max(0, head_weight - self._prev_boost_amount)
+        head_weak = head_weight < committee_weight * head_threshold_pct // 100
+        parent_strong = (
+            parent.weight > committee_weight * parent_threshold_pct // 100
+        )
+        if not (head_weak and parent_strong):
+            return None
+        return parent.root
+
     # ------------------------------------------------------------------ misc
 
     def ancestor_at_slot(self, root: bytes, slot: int) -> bytes | None:

@@ -857,8 +857,7 @@ class BeaconApi:
         return self._state(state_id).serialize()
 
     def produce_block_ssz(self, slot: int, randao_reveal: bytes) -> bytes:
-        block, _post = self.chain.produce_block_on_state(slot, randao_reveal)
-        return block.serialize()
+        return self._produce_block(slot, randao_reveal).serialize()
 
     def publish_attestations_ssz(self, data: bytes) -> int:
         """POST /eth/v1/beacon/pool/attestations with an SSZ-encoded
@@ -1409,9 +1408,17 @@ class BeaconApi:
             )
         return {"data": duties, "dependent_root": _hex(chain.head_root)}
 
-    def produce_block(self, slot: int, randao_reveal: bytes):
+    def _produce_block(self, slot: int, randao_reveal: bytes):
+        """The ONE production pipeline both renderings route through
+        (validator.rs produce_block/produce_block_v3 share a common
+        inner): the chain's proposer pipeline — get_proposer_head target
+        choice, pre-advanced snapshot, columnar packing — so the SSZ and
+        object routes can never drift apart."""
         block, _post = self.chain.produce_block_on_state(slot, randao_reveal)
         return block
+
+    def produce_block(self, slot: int, randao_reveal: bytes):
+        return self._produce_block(slot, randao_reveal)
 
 
 # ---------------------------------------------------------------------------

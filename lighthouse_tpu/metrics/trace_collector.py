@@ -42,6 +42,7 @@ ROOT_SPAN_NAMES = (
     "fork_choice_get_head",
     "slasher_process",
     "da_verify",
+    "block_production",
 )
 
 _RING_SIZE = int(os.environ.get("LIGHTHOUSE_TPU_TRACE_RING", "256"))

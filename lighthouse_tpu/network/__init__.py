@@ -889,6 +889,14 @@ class NetworkService:
         # client slot timer from double-processing
         if self.chain.slasher_service is not None:
             self.chain.slasher_service.on_slot(slot, processor=self.processor)
+        # next-slot state pre-advance rides its own low-priority lane
+        # (WorkType.STATE_ADVANCE) — queued here, never run on this
+        # heartbeat thread; the timer's slot claim keeps this and the
+        # client slot timer from double-advancing
+        if self.chain.state_advance_timer is not None:
+            self.chain.state_advance_timer.on_slot_tick(
+                slot, processor=self.processor
+            )
 
     def discover_and_connect(self, max_peers: int = 8) -> int:
         """One discovery round → dial every new connectable record

@@ -633,11 +633,36 @@ def is_valid_merkle_branch(
     return value == root
 
 
+# Cross-state pubkey->index hints: the per-state dict below dies with
+# every `state.copy()`, and block production/import always works on a
+# fresh copy — at 1M validators the rebuild is seconds of Python per
+# block. A pubkey's index never changes once assigned (the registry is
+# append-only), so a hint from ANY state lineage is verified against THIS
+# state with one element read and only a wrong/missing hint falls back to
+# the full scan. Forks that assign the same pubkey different indices
+# (duplicate deposits racing) fail the verification read and rescan —
+# the hint layer is an accelerator, never an authority.
+_PUBKEY_INDEX_HINTS: dict[bytes, int] = {}
+
+
 def _validator_index_by_pubkey(state, pubkey: bytes) -> int | None:
+    vs = state.validators
+    hint = _PUBKEY_INDEX_HINTS.get(pubkey)
+    if hint is not None and hint < len(vs) and vs[hint].pubkey == pubkey:
+        return hint
     cache = getattr(state, "_lh_pubkey_index", None)
-    if cache is None or len(cache) != len(state.validators):
-        cache = {v.pubkey: i for i, v in enumerate(state.validators)}
-        object.__setattr__(state, "_lh_pubkey_index", cache)
+    if cache is not None:
+        i = cache.get(pubkey)
+        if i is not None and i < len(vs) and vs[i].pubkey == pubkey:
+            return i
+        if i is None and getattr(state, "_lh_pubkey_scan_len", -1) == len(vs):
+            # the scan covered this exact registry: genuinely absent (the
+            # new-deposit existence check must stay O(1), not rescan)
+            return None
+    cache = {v.pubkey: i for i, v in enumerate(vs)}
+    object.__setattr__(state, "_lh_pubkey_index", cache)
+    object.__setattr__(state, "_lh_pubkey_scan_len", len(vs))
+    _PUBKEY_INDEX_HINTS.update(cache)
     return cache.get(pubkey)
 
 
@@ -739,6 +764,8 @@ def add_validator_to_registry(state, data, E):
     cache = getattr(state, "_lh_pubkey_index", None)
     if cache is not None:
         cache[data.pubkey] = len(state.validators) - 1
+        object.__setattr__(state, "_lh_pubkey_scan_len", len(state.validators))
+        _PUBKEY_INDEX_HINTS[data.pubkey] = len(state.validators) - 1
 
 
 def process_voluntary_exit(state, signed_exit, spec, E, verify_signatures: bool):

@@ -1667,3 +1667,304 @@ def run_column_withholding_scenario(
         }
     finally:
         net.shutdown()
+
+
+def _is_ancestor(chain, root: bytes, head: bytes) -> bool:
+    """Walk `head`'s parent links in the chain's block store."""
+    cur = bytes(head)
+    root = bytes(root)
+    while cur in chain._blocks_by_root:
+        if cur == root:
+            return True
+        cur = bytes(chain._blocks_by_root[cur].message.parent_root)
+    return cur == root
+
+
+def run_late_proposer_scenario(
+    spec,
+    E,
+    *,
+    node_count: int = 4,
+    validator_count: int = 32,
+    seed: int = 8,
+) -> dict:
+    """A proposer withholds its block past the attestation deadline: the
+    slot's committee, having seen nothing, attests to the PARENT; the
+    block limps in with no proposer boost; and the NEXT slot's proposer —
+    observing a weak, late, single-slot head over a strong parent (spec
+    `get_proposer_head`) — builds on the parent, orphaning the late
+    block while the fleet single-heads and finality keeps advancing.
+    The parent votes reach every node as same-slot gossip, so the
+    fork-choice deferral queue (not the op pool) is what carries them
+    into the re-org decision."""
+    from ..fork_choice.fork_choice import _total_balance
+    from ..state_processing import per_slot_processing
+    from ..state_processing.accessors import get_beacon_proposer_index
+
+    net = Testnet.create(
+        spec, E, node_count=node_count, validator_count=validator_count, seed=seed
+    )
+    try:
+        oracle = ChainHealthOracle(net)
+        S = E.SLOTS_PER_EPOCH
+        net.run_until_slot(S, start_slot=1)
+        oracle.check(require_single_head=True, what="healthy baseline")
+
+        # a locally-produced head is never re-orged (no gossip
+        # observation), so the late slot's proposer and the NEXT slot's
+        # proposer must sit on different nodes; keys are dealt in
+        # contiguous shares, so the owner is index // share
+        share = validator_count // node_count
+
+        def proposer_node(slot: int) -> int:
+            st = net.nodes[0].chain.head_state.copy()
+            while st.slot < slot:
+                per_slot_processing(st, spec, E)
+            return min(get_beacon_proposer_index(st, E) // share, node_count - 1)
+
+        # the regime needs a clean launch pad: one converged head AT the
+        # slot before the late one (a straggler block or missed proposal
+        # makes the single-slot re-org premise ragged), with the late and
+        # re-org proposers on different nodes
+        late_slot = S + 1
+        while True:
+            net.settle()
+            heads = {n.chain.head_root for n in net.nodes}
+            if (
+                len(heads) == 1
+                and int(net.nodes[0].chain.head_state.slot) == late_slot - 1
+                and proposer_node(late_slot) != proposer_node(late_slot + 1)
+            ):
+                break
+            if late_slot > 4 * S:
+                raise ScenarioFailure(
+                    f"[seed={net.seed}] no usable late slot found by "
+                    f"{late_slot} (heads={len(heads)})"
+                )
+            net.run_slot(late_slot)
+            late_slot += 1
+        parent = net.nodes[0].chain.head_root
+        deadline = net.nodes[0].client.slot_clock.attestation_deadline_offset
+        deferred_before = REGISTRY.counter(
+            "fork_choice_deferred_attestations_total"
+        ).value(outcome="applied")
+
+        # the late slot, in wall-clock order: attesters fire at the
+        # deadline with no block in sight (head vote = parent) ...
+        net.set_slot(late_slot)
+        for n in net.nodes:
+            try:
+                n.vc.attestation_service.attest(late_slot, n.chain.head_root)
+                n.vc.attestation_service.aggregate_if_selected(late_slot)
+            except Exception as e:  # noqa: BLE001 — scenario-normal misses
+                log.info("attestation missed", node=n.name, error=str(e)[:120])
+        net.settle()
+        # ... then the block limps in past the deadline on every clock:
+        # observed offsets land late, timeliness (and the boost) is lost
+        for n in net.nodes + net.attackers:
+            n.client.slot_clock.set_seconds_into_slot(deadline + 1.0)
+        late_root = None
+        for n in net.nodes:
+            try:
+                r = n.vc.block_service.propose_if_due(late_slot)
+                late_root = r if r is not None else late_root
+            except Exception as e:  # noqa: BLE001
+                log.info("proposal missed", node=n.name, error=str(e)[:120])
+        if late_root is None:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] no block proposed at the late slot"
+            )
+        # settle() keys on head EQUALITY, which already holds while the
+        # late block is still in flight (every head == parent): wait for
+        # the adoption itself
+        net.wait_for(
+            lambda: all(n.chain.head_root == late_root for n in net.nodes),
+            what="late block adopted fleet-wide",
+        )
+
+        # next slot, early enough to win the boost: the proposer re-orgs
+        for n in net.nodes + net.attackers:
+            n.client.slot_clock.set_seconds_into_slot(0.0)
+        net.set_slot(late_slot + 1)
+
+        # the parent votes ride gossip through each node's processor
+        # lanes into the deferral queue — wait until a recompute (tick +
+        # drain, exactly what the proposer's decision path runs) shows
+        # the parent past the re-org strength threshold on EVERY node,
+        # or the decision races the very votes that justify it
+        def _parent_votes_drained() -> bool:
+            for n in net.nodes:
+                n.chain.recompute_head()
+                fc = n.chain.fork_choice
+                pa = fc.proto.proto_array
+                pi = pa.indices.get(parent)
+                if pi is None:
+                    return False
+                cw = _total_balance(fc._justified_balances) // S
+                needed = cw * n.chain.spec.reorg_parent_weight_threshold // 100
+                if int(pa._weights[pi]) <= needed:
+                    return False
+            return True
+
+        net.wait_for(
+            _parent_votes_drained,
+            what="parent votes drained into fork-choice weights",
+        )
+        reorg_root = None
+        for n in net.nodes:
+            try:
+                r = n.vc.block_service.propose_if_due(late_slot + 1)
+                reorg_root = r if r is not None else reorg_root
+            except Exception as e:  # noqa: BLE001
+                log.info("proposal missed", node=n.name, error=str(e)[:120])
+        if reorg_root is None:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] no block proposed at the re-org slot"
+            )
+        net.wait_for(
+            lambda: all(
+                reorg_root in n.chain._blocks_by_root for n in net.nodes
+            ),
+            what="re-org block imported fleet-wide",
+        )
+        reorg_block = net.nodes[0].chain._blocks_by_root[reorg_root].message
+        if bytes(reorg_block.parent_root) != bytes(parent):
+            raise ScenarioFailure(
+                f"[seed={net.seed}] re-org block built on "
+                f"{bytes(reorg_block.parent_root).hex()[:8]}, not the "
+                f"parent {bytes(parent).hex()[:8]} — late head survived"
+            )
+        for n in net.nodes:
+            try:
+                n.vc.attestation_service.attest(
+                    late_slot + 1, n.chain.head_root
+                )
+                n.vc.attestation_service.aggregate_if_selected(late_slot + 1)
+            except Exception as e:  # noqa: BLE001
+                log.info("attestation missed", node=n.name, error=str(e)[:120])
+        net.settle()
+        heads = {n.chain.head_root for n in net.nodes}
+        if heads != {reorg_root}:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] fleet did not converge on the re-org "
+                f"block (heads={sorted(h.hex()[:8] for h in heads)})"
+            )
+        deferred_applied = (
+            REGISTRY.counter("fork_choice_deferred_attestations_total").value(
+                outcome="applied"
+            )
+            - deferred_before
+        )
+        if deferred_applied <= 0:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] no deferred attestations were applied "
+                "— the parent votes never reached fork choice"
+            )
+
+        # the chain keeps finalizing over the depth-1 re-org
+        recovery = _run_to_convergence(net, oracle, start_slot=late_slot + 2)
+        blocks = oracle.check(
+            require_single_head=True,
+            min_finalized_epoch=1,
+            max_reorg_depth=1,
+            what="post-reorg health",
+        )
+        for n in net.nodes:
+            if _is_ancestor(n.chain, late_root, n.chain.head_root):
+                raise ScenarioFailure(
+                    f"[seed={net.seed}] {n.name}: orphaned late block "
+                    "re-entered the canonical chain"
+                )
+        return {
+            "seed": net.seed,
+            "late_slot": late_slot,
+            "deferred_applied": deferred_applied,
+            "finalized": [c["finalized_epoch"] for c in blocks],
+            **recovery,
+        }
+    finally:
+        net.shutdown()
+
+
+def run_production_under_flood_scenario(
+    spec,
+    E,
+    *,
+    node_count: int = 4,
+    validator_count: int = 32,
+    seed: int = 9,
+    flood_epochs: int = 3,
+    max_mean_production_s: float = 1.0,
+) -> dict:
+    """Attacker nodes flood the gossip lanes while proposals keep
+    coming due: every slot's block must still be produced and published
+    (the STATE_ADVANCE lane and block_production pipeline share workers
+    with the flood's shed queues), the `block_production` trace root
+    must keep a bounded mean, and the chain must single-head and
+    finalize through it."""
+    net = Testnet.create(
+        spec,
+        E,
+        node_count=node_count,
+        validator_count=validator_count,
+        seed=seed,
+        attacker_count=2,
+    )
+    try:
+        oracle = ChainHealthOracle(net)
+        S = E.SLOTS_PER_EPOCH
+        net.run_until_slot(S, start_slot=1)
+        oracle.check(require_single_head=True, what="healthy baseline")
+        hist = REGISTRY.histogram("trace_span_seconds_block_production")
+        _, _, count_before, sum_before = hist.snapshot()
+        published_before = REGISTRY.counter("vc_blocks_published_total").value()
+        shed_before = _flood_shed_counters()
+        net.start_flood()
+        end = S + flood_epochs * S + S // 2
+        net.run_until_slot(end, start_slot=S + 1)
+        net.stop_flood()
+        _, _, count_after, sum_after = hist.snapshot()
+        published = (
+            REGISTRY.counter("vc_blocks_published_total").value()
+            - published_before
+        )
+        produced = count_after - count_before
+        flood_slots = end - S
+        if published < flood_slots * 0.9:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] only {published:.0f}/{flood_slots} "
+                "proposals published under flood"
+            )
+        mean_s = (sum_after - sum_before) / max(produced, 1)
+        if mean_s > max_mean_production_s:
+            raise ScenarioFailure(
+                f"[seed={net.seed}] mean block production "
+                f"{mean_s * 1000:.0f} ms under flood exceeds "
+                f"{max_mean_production_s * 1000:.0f} ms"
+            )
+        shed_delta = {
+            k: v - shed_before[k] for k, v in _flood_shed_counters().items()
+        }
+        if net.flood_sent and not any(shed_delta.values()):
+            raise ScenarioFailure(
+                f"[seed={net.seed}] flood sent {net.flood_sent} messages "
+                f"but no shed counter moved: {shed_delta}"
+            )
+        blocks = oracle.check(
+            require_single_head=True,
+            min_finalized_epoch=flood_epochs - 2,
+            min_participation=0.8,
+            what="fleet producing under flood",
+        )
+        recovery = _run_to_convergence(net, oracle, start_slot=end + 1)
+        return {
+            "seed": net.seed,
+            "flood_sent": net.flood_sent,
+            "blocks_published": published,
+            "mean_production_ms": round(mean_s * 1000, 2),
+            "shed": shed_delta,
+            "finalized": [c["finalized_epoch"] for c in blocks],
+            **recovery,
+        }
+    finally:
+        net.shutdown()

@@ -8,6 +8,9 @@ import time
 
 
 class SlotClock:
+    #: both concrete clocks carry this; declared for the deadline helpers
+    seconds_per_slot: int
+
     def now(self) -> int:
         raise NotImplementedError
 
@@ -23,6 +26,20 @@ class SlotClock:
         histograms observe (the reference's `seconds_from_current_slot_start`
         family). Negative for future slots."""
         raise NotImplementedError
+
+    @property
+    def attestation_deadline_offset(self) -> float:
+        """Slot-relative attestation deadline: SECONDS_PER_SLOT/3, the
+        instant attesters vote (`unagg_attestation_production_delay`). A
+        block observed past this offset arrived after the voters already
+        committed — the lateness bar for both the late-head WARNING and
+        the proposer re-org decision."""
+        return self.seconds_per_slot / 3
+
+    def is_past_attestation_deadline(self, slot: int) -> bool:
+        """Whether `slot`'s attestation deadline has passed on this
+        clock (true for every earlier slot)."""
+        return self.slot_offset_seconds(slot) > self.attestation_deadline_offset
 
 
 class SystemTimeSlotClock(SlotClock):

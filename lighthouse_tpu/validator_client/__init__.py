@@ -463,19 +463,26 @@ class BlockService:
         duty = self.duties.proposer_duty_at(slot)
         if duty is None:
             return None
+        from ..utils.tracing import span
+
         _proposer_index, pubkey, advanced_state = duty
         epoch = compute_epoch_at_slot(slot, self.E)
-        randao = self.store.sign_randao(
-            pubkey, epoch, advanced_state, self.spec, self.E
-        )
-        block = self.node.produce_block(slot, randao)
-        try:
-            sig = self.store.sign_block(
-                pubkey, block, advanced_state, self.spec, self.E
+        # one block_production trace covers randao + produce + sign; the
+        # chain's advance/pack/assemble stages nest under it. The publish
+        # stays OUTSIDE: the resulting import is its own trace root.
+        with span("block_production", slot=int(slot)):
+            randao = self.store.sign_randao(
+                pubkey, epoch, advanced_state, self.spec, self.E
             )
-        except NotSafe:
-            inc_counter("vc_slashing_protection_refusals_total")
-            return None
+            block = self.node.produce_block(slot, randao)
+            try:
+                with span("sign"):
+                    sig = self.store.sign_block(
+                        pubkey, block, advanced_state, self.spec, self.E
+                    )
+            except NotSafe:
+                inc_counter("vc_slashing_protection_refusals_total")
+                return None
         from ..types.containers import build_types
 
         t = build_types(self.E)

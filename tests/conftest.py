@@ -39,7 +39,11 @@ def pytest_sessionstart(session):
     )
     from lighthouse_tpu.crypto import bls  # noqa: F401 — registers counters
     from lighthouse_tpu.fork_choice import (  # noqa: F401 — registers
+        fork_choice,  # deferred-attestation outcome counters
         proto_array,  # vote-path counter + get_head stage span histograms
+    )
+    from lighthouse_tpu.beacon_chain import (  # noqa: F401 — registers
+        state_advance,  # snapshot cache counters + production stage spans
     )
     from lighthouse_tpu.metrics import REGISTRY
     from lighthouse_tpu.metrics import profiler  # noqa: F401 — registers
@@ -281,6 +285,21 @@ def pytest_sessionstart(session):
         "trace_span_seconds_da_derive",
         "trace_span_seconds_da_msm",
         "trace_span_seconds_da_pairing",
+        # PR 17: the proposer-pipeline series — snapshot-cache accounting,
+        # the block_production trace root's stage spans, and the
+        # fork-choice deferral queue outcomes — must exist at zero (the
+        # block_production bench reads the stage breakdown eagerly and
+        # the fleet scenarios difference the deferral counters)
+        "state_advance_hits_total",
+        "state_advance_misses_total",
+        "state_advance_wasted_total",
+        "trace_span_seconds_block_production",
+        "trace_span_seconds_advance",
+        "trace_span_seconds_pack",
+        "trace_span_seconds_sign",
+        'fork_choice_deferred_attestations_total{outcome="deferred"}',
+        'fork_choice_deferred_attestations_total{outcome="applied"}',
+        'fork_choice_deferred_attestations_total{outcome="dropped"}',
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"

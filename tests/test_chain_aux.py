@@ -117,6 +117,8 @@ def test_block_times_cache_records_pipeline():
 
 
 def test_state_advance_precompute_used_by_import():
+    from lighthouse_tpu.metrics import REGISTRY
+
     h = _harness()
     h.extend_chain(2)
     timer = StateAdvanceTimer(h.chain)
@@ -124,10 +126,17 @@ def test_state_advance_precompute_used_by_import():
     timer.on_slot_tick(cur)  # pre-builds state for slot cur+1
     cached = h.chain.state_advance_cache._state
     assert cached is not None and cached.slot == cur + 1
-    # import at cur+1 consumes the pre-advanced state
+    # import at cur+1 consumes the pre-advanced state (a hit, not a
+    # waste); the head move to the imported block then drops the entry,
+    # which was keyed off the now-old head
+    hits = REGISTRY.counter("state_advance_hits_total")
+    wasted = REGISTRY.counter("state_advance_wasted_total")
+    before_h, before_w = hits.value(), wasted.value()
     h.slot_clock.set_slot(cur + 1)
     h.add_block_at_slot(cur + 1)
-    assert h.chain.state_advance_cache._state is None  # consumed
+    assert hits.value() == before_h + 1
+    assert wasted.value() == before_w
+    assert h.chain.state_advance_cache._state is None  # head moved on
     assert h.chain.head_state.slot == cur + 1
 
 

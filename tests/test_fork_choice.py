@@ -246,3 +246,115 @@ def test_on_tick_promotes_unrealized_checkpoints():
     fc.on_tick(MinimalEthSpec.SLOTS_PER_EPOCH)  # cross into epoch 1
     assert fc.store.justified_checkpoint.epoch == 1
     assert fc.store.justified_checkpoint.root == R(1)
+
+
+# ---------------------------------------------------------------------------
+# Same-slot gossip deferral (fork_choice.rs queued_attestations)
+# ---------------------------------------------------------------------------
+
+from lighthouse_tpu.metrics import REGISTRY  # noqa: E402
+
+
+def _deferred(outcome):
+    return REGISTRY.counter("fork_choice_deferred_attestations_total").value(
+        outcome=outcome
+    )
+
+
+def _deferral_wrapper():
+    fc = make_wrapper(current_slot=2)
+    add_block(fc.proto, 1, R(1), R(0))
+    add_block(fc.proto, 2, R(2), R(1))
+    return fc
+
+
+def test_same_slot_gossip_attestation_defers_until_tick():
+    """A gossip vote from the store's current slot queues (it would fail
+    the "from the future" recency rule) and drains into the vote tracker
+    on the tick that clears it — the weight the next slot's proposer-boost
+    re-org decision reads."""
+    T = build_types(MinimalEthSpec)
+    fc = _deferral_wrapper()
+    d0, a0 = _deferred("deferred"), _deferred("applied")
+    fc.on_attestation(_attestation(T, 2, R(2), 0, R(0)))
+    assert len(fc._deferred_attestations) == 1
+    assert _deferred("deferred") == d0 + 1
+    assert fc.proto._next_rid.size == 0  # vote NOT applied yet
+    fc.on_tick(3)
+    assert fc._deferred_attestations == []
+    assert _deferred("applied") == a0 + 1
+    assert int(fc.proto._next_rid[0]) == fc.proto.proto_array.vote_root_id(
+        R(2)
+    )
+
+
+def test_store_lagging_gossip_attestation_defers_until_its_tick():
+    """The store only advances on ticks: a wall-clock slot-3 vote arriving
+    while the store still reads slot 2 must queue (not reject), and must
+    stay queued through the slot-3 tick — it drains at slot 4."""
+    T = build_types(MinimalEthSpec)
+    fc = _deferral_wrapper()
+    fc.on_attestation(_attestation(T, 3, R(2), 0, R(0)))
+    assert len(fc._deferred_attestations) == 1
+    fc.on_tick(3)
+    assert len(fc._deferred_attestations) == 1  # slot-3 vote not yet clear
+    fc.on_tick(4)
+    assert fc._deferred_attestations == []
+    assert int(fc.proto._next_rid[0]) == fc.proto.proto_array.vote_root_id(
+        R(2)
+    )
+
+
+def test_deferred_attestation_structurally_validated_at_enqueue():
+    """Structural validation runs at enqueue time (is_from_block=True
+    skips only the two gossip recency rules), so garbage never occupies
+    the queue waiting for a tick to bounce it."""
+    T = build_types(MinimalEthSpec)
+    fc = _deferral_wrapper()
+    with pytest.raises(InvalidAttestation):
+        fc.on_attestation(_attestation(T, 2, R(9), 0, R(0)))  # unknown head
+    assert fc._deferred_attestations == []
+
+
+def test_past_slot_gossip_attestation_applies_immediately():
+    T = build_types(MinimalEthSpec)
+    fc = _deferral_wrapper()
+    fc.on_attestation(_attestation(T, 1, R(1), 0, R(0)))
+    assert fc._deferred_attestations == []
+    assert int(fc.proto._next_rid[0]) == fc.proto.proto_array.vote_root_id(
+        R(1)
+    )
+
+
+def test_deferral_queue_cap_sheds(monkeypatch):
+    import lighthouse_tpu.fork_choice.fork_choice as fc_mod
+
+    monkeypatch.setattr(fc_mod, "_MAX_DEFERRED_ATTESTATIONS", 2)
+    T = build_types(MinimalEthSpec)
+    fc = _deferral_wrapper()
+    x0 = _deferred("dropped")
+    for vi in range(3):
+        fc.on_attestation(_attestation(T, 2, R(2), 0, R(0), indices=(vi,)))
+    assert len(fc._deferred_attestations) == 2
+    assert _deferred("dropped") == x0 + 1
+
+
+def test_batch_path_defers_same_slot_votes_too():
+    """on_attestation_batch reports a deferred vote as accepted (None) —
+    it is consumed, just later — and the drain applies it through the
+    vectorized batch write."""
+    T = build_types(MinimalEthSpec)
+    fc = _deferral_wrapper()
+    results = fc.on_attestation_batch(
+        [
+            _attestation(T, 2, R(2), 0, R(0), indices=(0, 1)),
+            _attestation(T, 1, R(1), 0, R(0), indices=(2,)),
+        ]
+    )
+    assert results == [None, None]
+    assert len(fc._deferred_attestations) == 1
+    rid1 = fc.proto.proto_array.vote_root_id(R(1))
+    assert int(fc.proto._next_rid[2]) == rid1  # past-slot vote landed now
+    fc.on_tick(3)
+    rid2 = fc.proto.proto_array.vote_root_id(R(2))
+    assert [int(fc.proto._next_rid[v]) for v in (0, 1)] == [rid2, rid2]

@@ -598,3 +598,31 @@ def test_sampled_block_import_overhead_bounded():
         f"sampling overhead out of bounds: on={on * 1000:.2f}ms "
         f"off={off * 1000:.2f}ms"
     )
+
+
+def test_bench_compare_direction_probe():
+    """Unit-string direction detection: every throughput unit in the
+    suite must read higher-is-better — testnet_soak's "per wall-second"
+    phrasing once read as a latency, flagging a +25% improvement as
+    REGRESSED — and the "/s " probe must not catch "ms/…" latencies."""
+    import bench
+
+    for unit in (
+        "sets/sec",
+        "leaves/sec",
+        "blocks/sec (two-node loopback catch-up, batch state machine)",
+        "cells/s (batched RLC lane)",
+        "slots finalized per wall-second (5-node fleet, healthy soak)",
+        "req/sec (hot-cache full-table validators at 1000000 validators)",
+    ):
+        assert bench._higher_is_better(unit), unit
+    for unit in (
+        "ms/block (produce+sign+import)",
+        "ms/block (pre-advanced, epoch boundary, 1M validators)",
+        "ms/epoch (1000000 validators, minimal preset)",
+        "s/cold columnar build",
+        "s heal->finality (after >=50% recovery import)",
+        "",
+        None,
+    ):
+        assert not bench._higher_is_better(unit), unit

@@ -1,0 +1,425 @@
+"""Proposer-boost late-block re-orgs and the proposer pipeline.
+
+`get_proposer_head` at three layers: directed condition tests on the
+columnar proto-array (each re-org precondition flipped in isolation),
+differential fuzz against the retained scalar oracle, and chain-level
+end-to-end — a weak late head makes `produce_block_on_state` build on
+its parent, with the observation-time gates (lateness, re-org cutoff,
+finalization distance) exercised on the real chain. Plus the HTTP
+surface dedup: the SSZ and object renderings of block production are
+byte-identical through the one pipeline."""
+
+import random
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.fork_choice import (
+    ProtoArrayForkChoice,
+    ProtoArrayForkChoiceReference,
+)
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec
+
+E = MinimalEthSpec
+
+R = lambda i: b"\xaa" + i.to_bytes(4, "big") + b"\x00" * 27  # noqa: E731
+ZERO = b"\x00" * 32
+
+
+@pytest.fixture(autouse=True)
+def _fake_crypto():
+    prev = bls.backend_name()
+    bls.set_backend("fake_crypto")
+    yield
+    bls.set_backend(prev)
+
+
+def _harness(n=16):
+    return BeaconChainHarness(minimal_spec(), E, validator_count=n)
+
+
+# ---------------------------------------------------------------------------
+# proto-array directed conditions
+# ---------------------------------------------------------------------------
+
+#: committee_weight=125 with the spec thresholds: head weak < 25,
+#: parent strong > 200
+CW, HEAD_PCT, PARENT_PCT, SPE = 125, 20, 160, 8
+
+
+def _chain_pair(head_uje=None, parent_uje=None, head_slot=2, parent_slot=1):
+    """anchor(R0)@0 <- parent(R1) <- head(R2); 10 validators of 100."""
+    col = ProtoArrayForkChoice(R(0), 0, R(0), 0, 0)
+    ref = ProtoArrayForkChoiceReference(R(0), 0, R(0), 0, 0)
+    for fc in (col, ref):
+        fc.on_block(
+            slot=parent_slot,
+            root=R(1),
+            parent_root=R(0),
+            state_root=R(1),
+            justified_epoch=0,
+            finalized_epoch=0,
+            unrealized_justified_epoch=parent_uje,
+        )
+        fc.on_block(
+            slot=head_slot,
+            root=R(2),
+            parent_root=R(1),
+            state_root=R(2),
+            justified_epoch=0,
+            finalized_epoch=0,
+            unrealized_justified_epoch=head_uje,
+        )
+    return col, ref
+
+
+def _run_head(col, ref, parent_votes=8, head_votes=0, boost=(ZERO, 0)):
+    balances = [100] * 10
+    for v in range(parent_votes):
+        col.process_attestation(v, R(1), 0)
+        ref.process_attestation(v, R(1), 0)
+    for v in range(parent_votes, parent_votes + head_votes):
+        col.process_attestation(v, R(2), 0)
+        ref.process_attestation(v, R(2), 0)
+    kw = dict(
+        justified_checkpoint_root=R(0),
+        justified_epoch=0,
+        finalized_epoch=0,
+        proposer_boost_root=boost[0],
+        proposer_boost_amount=boost[1],
+        equivocating_indices=set(),
+    )
+    col.get_head(
+        justified_state_balances=np.asarray(balances, dtype=np.uint64), **kw
+    )
+    ref.get_head(justified_state_balances=balances, **kw)
+
+
+def _both(col, ref, slot, head_root=R(2), cw=CW, spe=SPE):
+    a = col.proto_array.get_proposer_head(
+        slot, head_root, cw, HEAD_PCT, PARENT_PCT, spe
+    )
+    b = ref.proto_array.get_proposer_head(
+        slot, head_root, cw, HEAD_PCT, PARENT_PCT, spe
+    )
+    assert a == b
+    return a
+
+
+def test_reorg_fires_on_weak_late_single_slot_head():
+    col, ref = _chain_pair()
+    _run_head(col, ref)  # parent weight 800, head weight 0
+    assert _both(col, ref, 3) == R(1)
+
+
+def test_no_reorg_when_head_not_weak():
+    col, ref = _chain_pair()
+    _run_head(col, ref, parent_votes=7, head_votes=1)  # head weight 100 >= 25
+    assert _both(col, ref, 3) is None
+
+
+def test_no_reorg_when_parent_not_strong():
+    col, ref = _chain_pair()
+    _run_head(col, ref, parent_votes=2)  # parent weight 200, not > 200
+    assert _both(col, ref, 3) is None
+
+
+def test_no_reorg_across_epoch_boundary():
+    col, ref = _chain_pair()
+    _run_head(col, ref)
+    assert _both(col, ref, 3, spe=3) is None  # 3 % 3 == 0: shuffling flips
+
+
+def test_no_reorg_unless_proposing_next_slot():
+    col, ref = _chain_pair()
+    _run_head(col, ref)
+    assert _both(col, ref, 4) is None  # skipped slot after the head
+
+
+def test_no_reorg_on_multi_slot_head():
+    col, ref = _chain_pair(head_slot=3)  # parent@1 <- head@3: gap
+    _run_head(col, ref)
+    assert _both(col, ref, 4) is None
+
+
+def test_no_reorg_when_ffg_not_competitive():
+    col, ref = _chain_pair(head_uje=1, parent_uje=0)
+    _run_head(col, ref)
+    assert _both(col, ref, 3) is None
+
+
+def test_reorg_judges_head_without_its_boost():
+    # the last get_head pass boosted the (otherwise voteless) head; the
+    # re-org decision backs the boost out and still sees a weak head
+    col, ref = _chain_pair()
+    _run_head(col, ref, boost=(R(2), 500))
+    pa = col.proto_array
+    assert int(pa._weights[pa.indices[R(2)]]) == 500  # boost in the column
+    assert _both(col, ref, 3) == R(1)
+
+
+def test_no_reorg_for_unknown_or_anchor_head():
+    col, ref = _chain_pair()
+    _run_head(col, ref)
+    assert _both(col, ref, 3, head_root=R(9)) is None  # unknown
+    assert _both(col, ref, 1, head_root=R(0)) is None  # anchor: no parent
+
+
+# ---------------------------------------------------------------------------
+# differential fuzz vs the scalar oracle
+# ---------------------------------------------------------------------------
+
+
+def test_proposer_head_differential_fuzz():
+    for seed in range(12):
+        rng = random.Random(seed)
+        col = ProtoArrayForkChoice(R(0), 0, R(0), 0, 0)
+        ref = ProtoArrayForkChoiceReference(R(0), 0, R(0), 0, 0)
+        roots, slots = [R(0)], {R(0): 0}
+        n_val = 32
+        balances = [100 + rng.randint(0, 50) for _ in range(n_val)]
+        next_root = 1
+        for _ in range(40):
+            op = rng.random()
+            if op < 0.45:
+                parent = rng.choice(roots[-6:])
+                root = R(next_root)
+                next_root += 1
+                slot = slots[parent] + rng.randint(1, 2)
+                slots[root] = slot
+                kw = dict(
+                    slot=slot,
+                    root=root,
+                    parent_root=parent,
+                    state_root=root,
+                    justified_epoch=0,
+                    finalized_epoch=0,
+                    unrealized_justified_epoch=rng.choice([None, 0, 1]),
+                )
+                col.on_block(**kw)
+                ref.on_block(**kw)
+                roots.append(root)
+            elif op < 0.85:
+                target = rng.choice(roots)
+                for v in rng.sample(range(n_val), rng.randint(1, 8)):
+                    col.process_attestation(v, target, 0)
+                    ref.process_attestation(v, target, 0)
+            else:
+                boost_root = (
+                    rng.choice(roots) if rng.random() < 0.5 else ZERO
+                )
+                kw = dict(
+                    justified_checkpoint_root=R(0),
+                    justified_epoch=0,
+                    finalized_epoch=0,
+                    proposer_boost_root=boost_root,
+                    proposer_boost_amount=(
+                        rng.randint(1, 400) if boost_root != ZERO else 0
+                    ),
+                    equivocating_indices=set(),
+                )
+                col.get_head(
+                    justified_state_balances=np.asarray(
+                        balances, dtype=np.uint64
+                    ),
+                    **kw,
+                )
+                ref.get_head(justified_state_balances=list(balances), **kw)
+            # every node is a proposer-head candidate every step — the
+            # decision must be differential-equal across the whole array
+            cw = rng.randint(0, 600)
+            spe = rng.choice([4, 8])
+            for root in rng.sample(roots, min(len(roots), 5)):
+                slot = slots[root] + rng.choice([1, 2])
+                a = col.proto_array.get_proposer_head(
+                    slot, root, cw, HEAD_PCT, PARENT_PCT, spe
+                )
+                b = ref.proto_array.get_proposer_head(
+                    slot, root, cw, HEAD_PCT, PARENT_PCT, spe
+                )
+                assert a == b, (seed, root.hex()[:10], slot, cw, spe)
+
+
+# ---------------------------------------------------------------------------
+# chain-level end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _rig_late_weak_head(h, late_seconds=5.0, chain_slots=None):
+    """Build silently into epoch 1, cast the fleet's first (and therefore
+    registering — VoteTracker is epoch-monotonic) votes on the intended
+    parent, then land one unattested block observed `late_seconds` into
+    its slot. Returns (parent_root, late_root, slot)."""
+    h.extend_chain(
+        E.SLOTS_PER_EPOCH + 1 if chain_slots is None else chain_slots,
+        attest=False,
+    )
+    parent = h.chain.head_root
+    slot = int(h.chain.head_state.slot) + 1
+    h.slot_clock.set_slot(slot)
+    h.slot_clock.set_seconds_into_slot(late_seconds)
+    h.add_block_at_slot(slot)
+    h.slot_clock.set_seconds_into_slot(0.0)
+    late = h.chain.head_root
+    assert late != parent
+    # Votes only count from the slot after the attestation's (the store
+    # rejects same-slot votes as "from the future"), so ingest the parent
+    # votes once the proposal slot begins: the parent's own committee,
+    # plus slot `slot`'s committee — which missed the late block by the
+    # attestation deadline and attested the parent it could see. Two
+    # committees put the parent at ~200% of one committee's weight,
+    # clearing the 160% strong-parent bar.
+    h.slot_clock.set_slot(slot + 1)
+    h.chain.fork_choice.on_tick(slot + 1)
+    atts = h.make_unaggregated_attestations(
+        slot - 1, parent
+    ) + h.make_unaggregated_attestations(slot, parent)
+    h.chain.process_attestation_batch(atts)
+    h.chain.recompute_head()  # apply pending votes -> fresh weight columns
+    assert h.chain.head_root == late  # still head: the parent's only child
+    return parent, late, slot
+
+
+def test_chain_reorgs_out_late_weak_head():
+    h = _harness()
+    parent, late, slot = _rig_late_weak_head(h)  # observed past the 2 s deadline
+    h.slot_clock.set_slot(slot + 1)
+    assert h.chain.get_proposer_head(slot + 1) == parent
+    block, _post = h.chain.produce_block_on_state(
+        slot + 1, h.randao_reveal(0, slot + 1)
+    )
+    assert block.parent_root == parent  # built around the weak head
+
+
+def test_chain_keeps_timely_head():
+    h = _harness()
+    # same weak-head rig, but the head was observed ON time: no re-org
+    parent, head, slot = _rig_late_weak_head(h, late_seconds=0.0)
+    h.slot_clock.set_slot(slot + 1)
+    assert h.chain.get_proposer_head(slot + 1) == head
+
+
+def test_chain_keeps_late_head_past_reorg_cutoff():
+    h = _harness()
+    _parent, late, slot = _rig_late_weak_head(h)
+    h.slot_clock.set_slot(slot + 1)
+    # proposing too deep into the slot to win our own boost: keep head
+    h.slot_clock.set_seconds_into_slot(1.5)  # cutoff is deadline/2 = 1.0 s
+    assert h.chain.get_proposer_head(slot + 1) == late
+    h.slot_clock.set_seconds_into_slot(0.0)
+
+
+def test_chain_keeps_late_head_when_finality_lags():
+    h = _harness()
+    _parent, late, slot = _rig_late_weak_head(h)
+    # pretend finality stalled relative to the spec knob: any re-org is
+    # too risky when the chain is not finalizing
+    h.chain.spec.reorg_max_epochs_since_finalization = 0
+    h.slot_clock.set_slot(slot + 1)
+    epoch = (slot + 1) // E.SLOTS_PER_EPOCH
+    assert epoch > h.chain.fork_choice.store.finalized_checkpoint.epoch
+    assert h.chain.get_proposer_head(slot + 1) == late
+
+
+def test_chain_keeps_head_across_epoch_boundary():
+    h = _harness()
+    # land the late weak head on the last slot of an epoch: proposing the
+    # first slot of the next epoch must never re-org (shuffling stability)
+    _parent, late, slot = _rig_late_weak_head(
+        h, chain_slots=2 * E.SLOTS_PER_EPOCH - 2
+    )
+    assert (slot + 1) % E.SLOTS_PER_EPOCH == 0
+    h.slot_clock.set_slot(slot + 1)
+    assert h.chain.get_proposer_head(slot + 1) == late
+
+
+# ---------------------------------------------------------------------------
+# production pipeline plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_production_consumes_preadvanced_snapshot():
+    from lighthouse_tpu.beacon_chain.state_advance import StateAdvanceTimer
+    from lighthouse_tpu.metrics import REGISTRY
+
+    h = _harness()
+    h.extend_chain(3)
+    timer = StateAdvanceTimer(h.chain)
+    cur = int(h.chain.head_state.slot)
+    timer.on_slot_tick(cur)
+    hits = REGISTRY.counter("state_advance_hits_total")
+    before = hits.value()
+    h.slot_clock.set_slot(cur + 1)
+    block, post = h.chain.produce_block_on_state(
+        cur + 1, h.randao_reveal(0, cur + 1)
+    )
+    assert hits.value() == before + 1
+    assert int(block.slot) == cur + 1
+    assert int(post.slot) == cur + 1
+
+
+def test_block_production_trace_root_with_stage_spans():
+    from lighthouse_tpu.metrics import REGISTRY
+    from lighthouse_tpu.metrics.trace_collector import COLLECTOR
+
+    h = _harness()
+    h.extend_chain(2)
+    counter = REGISTRY.counter("trace_collector_traces_total")
+    before = counter.value(root="block_production")
+    slot = int(h.chain.head_state.slot) + 1
+    h.slot_clock.set_slot(slot)
+    h.chain.produce_block_on_state(slot, h.randao_reveal(0, slot))
+    assert counter.value(root="block_production") == before + 1
+    trace = next(
+        t for t in COLLECTOR.recent() if t.name == "block_production"
+    )
+    stages = {c.name for c in trace.children}
+    assert {"advance", "pack", "assemble"} <= stages
+
+
+def test_vc_proposal_is_one_block_production_trace():
+    """The VC wraps randao+produce+sign in ONE root; the chain must not
+    mint a second one underneath it."""
+    from lighthouse_tpu.metrics import REGISTRY
+    from lighthouse_tpu.metrics.trace_collector import COLLECTOR
+    from lighthouse_tpu.validator_client import LocalBeaconNode, ValidatorClient
+
+    h = _harness()
+    h.extend_chain(2)
+    vc = ValidatorClient(
+        h.chain, h.keypairs, h.spec, E, node=LocalBeaconNode(h.chain)
+    )
+    counter = REGISTRY.counter("trace_collector_traces_total")
+    before = counter.value(root="block_production")
+    slot = int(h.chain.head_state.slot) + 1
+    h.slot_clock.set_slot(slot)
+    root = vc.on_slot(slot)
+    assert root is not None
+    assert counter.value(root="block_production") == before + 1
+    trace = next(
+        t for t in COLLECTOR.recent() if t.name == "block_production"
+    )
+    stages = {c.name for c in trace.children}
+    assert "sign" in stages
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface dedup
+# ---------------------------------------------------------------------------
+
+
+def test_produce_block_renderings_byte_identical():
+    from lighthouse_tpu.http_api import BeaconApi
+
+    h = _harness()
+    h.extend_chain(3)
+    api = BeaconApi(h.chain)
+    slot = int(h.chain.head_state.slot) + 1
+    h.slot_clock.set_slot(slot)
+    randao = h.randao_reveal(0, slot)
+    ssz = api.produce_block_ssz(slot, randao)
+    obj = api.produce_block(slot, randao)
+    assert ssz == obj.serialize()

@@ -1,0 +1,301 @@
+"""State-advance pre-computation: cache discipline (CoW hand-out,
+hit/miss/wasted accounting, head-change invalidation), the slot-claimed
+timer and its STATE_ADVANCE processor lane, and snapshot-aliasing fuzz —
+mutating a pre-advanced snapshot must never leak into the head state's
+resident columns or dirty channels, and vice versa."""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.beacon_chain.state_advance import (
+    StateAdvanceCache,
+    StateAdvanceTimer,
+)
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.metrics import REGISTRY
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec
+
+
+@pytest.fixture(autouse=True)
+def _fake_crypto():
+    prev = bls.backend_name()
+    bls.set_backend("fake_crypto")
+    yield
+    bls.set_backend(prev)
+
+
+def _harness(validators: int = 16) -> BeaconChainHarness:
+    return BeaconChainHarness(
+        minimal_spec(), MinimalEthSpec, validator_count=validators
+    )
+
+
+def _counts():
+    return tuple(
+        REGISTRY.counter(f"state_advance_{k}_total").value()
+        for k in ("hits", "misses", "wasted")
+    )
+
+
+class _State:
+    """Counterfeit state: enough surface for cache bookkeeping tests."""
+
+    def __init__(self, slot=0):
+        self.slot = slot
+        self.copies = 0
+
+    def copy(self):
+        self.copies += 1
+        c = _State(self.slot)
+        return c
+
+
+# ---------------------------------------------------------------------------
+# cache semantics
+# ---------------------------------------------------------------------------
+
+
+def test_cache_get_returns_copy_and_retains_entry():
+    c = StateAdvanceCache()
+    st = _State(slot=9)
+    c.put(b"\x01" * 32, 9, st)
+    h0, m0, w0 = _counts()
+    a = c.get(b"\x01" * 32, 9)
+    b = c.get(b"\x01" * 32, 9)
+    assert a is not None and b is not None
+    assert a is not st and b is not st and a is not b  # CoW copies
+    h1, m1, w1 = _counts()
+    # first consume is THE hit; the second hand-out of the same entry is
+    # not double-counted (one advance saved, however many readers)
+    assert (h1 - h0, m1 - m0, w1 - w0) == (1, 0, 0)
+
+
+def test_cache_miss_on_wrong_key():
+    c = StateAdvanceCache()
+    c.put(b"\x01" * 32, 9, _State(slot=9))
+    h0, m0, _ = _counts()
+    assert c.get(b"\x02" * 32, 9) is None
+    assert c.get(b"\x01" * 32, 8) is None
+    h1, m1, _ = _counts()
+    assert (h1 - h0, m1 - m0) == (0, 2)
+
+
+def test_cache_replacement_of_unconsumed_entry_is_wasted():
+    c = StateAdvanceCache()
+    c.put(b"\x01" * 32, 9, _State(slot=9))
+    _, _, w0 = _counts()
+    c.put(b"\x02" * 32, 10, _State(slot=10))  # first entry never consumed
+    _, _, w1 = _counts()
+    assert w1 - w0 == 1
+    c.get(b"\x02" * 32, 10)  # consume
+    c.put(b"\x03" * 32, 11, _State(slot=11))
+    _, _, w2 = _counts()
+    assert w2 - w1 == 0  # consumed entries are not wasted
+
+
+def test_cache_invalidate_spares_entry_for_new_head():
+    c = StateAdvanceCache()
+    c.put(b"\x01" * 32, 9, _State(slot=9))
+    _, _, w0 = _counts()
+    c.invalidate(b"\x01" * 32)  # head "changed" TO the entry's key
+    assert c.get(b"\x01" * 32, 9) is not None  # survived
+    c.invalidate(b"\x02" * 32)  # head changed away — drop (consumed: no waste)
+    assert c._state is None
+    c.put(b"\x01" * 32, 9, _State(slot=9))
+    c.invalidate(b"\x02" * 32)  # unconsumed drop
+    _, _, w1 = _counts()
+    assert w1 - w0 == 1
+    assert c._state is None
+
+
+def test_cache_clear_resets_without_wasted_accounting():
+    c = StateAdvanceCache()
+    c.put(b"\x01" * 32, 9, _State(slot=9))
+    _, _, w0 = _counts()
+    c.clear()
+    _, _, w1 = _counts()
+    assert w1 == w0
+    assert c.get(b"\x01" * 32, 9) is None
+
+
+# ---------------------------------------------------------------------------
+# timer: slot claims + processor lane
+# ---------------------------------------------------------------------------
+
+
+class _Chain:
+    """Counterfeit chain for timer-dispatch tests (no state transition)."""
+
+    def __init__(self):
+        self.head_root = b"\x07" * 32
+        self.head_state = _State(slot=5)
+        self.state_advance_cache = StateAdvanceCache()
+        self.state_advance_timer = None
+
+
+class _Processor:
+    def __init__(self, accept=True):
+        self.accept = accept
+        self.submitted = []
+
+    def submit(self, work_type, item, handler):
+        self.submitted.append((work_type, item, handler))
+        return self.accept
+
+
+def test_timer_attaches_to_chain_and_claims_slots():
+    ch = _Chain()
+    timer = StateAdvanceTimer(ch)
+    assert ch.state_advance_timer is timer
+    runs = []
+    timer._advance = runs.append
+    timer.on_slot_tick(5)
+    timer.on_slot_tick(5)  # competing driver, same slot: claimed already
+    timer.on_slot_tick(4)  # stale tick never un-advances
+    timer.on_slot_tick(6)
+    assert runs == [5, 6]
+
+
+def test_timer_submits_on_state_advance_lane():
+    from lighthouse_tpu.beacon_processor import WorkType
+
+    ch = _Chain()
+    timer = StateAdvanceTimer(ch)
+    proc = _Processor(accept=True)
+    timer.on_slot_tick(5, processor=proc)
+    assert len(proc.submitted) == 1
+    work_type, item, handler = proc.submitted[0]
+    assert work_type == WorkType.STATE_ADVANCE
+    assert item == 5 and handler == timer._advance
+    # the claim stands: the inline driver for the same slot is a no-op
+    runs = []
+    timer._advance = runs.append
+    timer.on_slot_tick(5)
+    assert runs == []
+
+
+def test_timer_refused_submit_unclaims_slot():
+    ch = _Chain()
+    timer = StateAdvanceTimer(ch)
+    proc = _Processor(accept=False)
+    timer.on_slot_tick(5, processor=proc)  # refused -> unclaimed
+    runs = []
+    timer._advance = runs.append
+    timer.on_slot_tick(5)  # retry wins the claim back
+    assert runs == [5]
+
+
+def test_state_advance_queue_bound_is_tiny():
+    from lighthouse_tpu.beacon_processor import _QUEUE_BOUNDS, WorkType
+
+    assert WorkType.STATE_ADVANCE < WorkType.SLASHER_PROCESS
+    assert _QUEUE_BOUNDS[WorkType.STATE_ADVANCE] <= 4
+
+
+# ---------------------------------------------------------------------------
+# timer: real advances
+# ---------------------------------------------------------------------------
+
+
+def test_timer_head_change_mid_advance_discards_as_wasted(monkeypatch):
+    from lighthouse_tpu.beacon_chain import state_advance as sa
+
+    h = _harness()
+    h.extend_chain(2)
+    timer = StateAdvanceTimer(h.chain)
+    cur = int(h.chain.head_state.slot)
+
+    real = sa.per_slot_processing
+
+    def flip_head_then_process(state, spec, E):
+        # the import of a competing block lands while the worker is mid-
+        # transition: the head root this advance is keyed off dies
+        h.chain.head_root = b"\xee" * 32
+        return real(state, spec, E)
+
+    monkeypatch.setattr(sa, "per_slot_processing", flip_head_then_process)
+    h0, _, w0 = _counts()
+    timer.on_slot_tick(cur)
+    h1, _, w1 = _counts()
+    assert w1 - w0 == 1
+    assert h1 == h0
+    assert h.chain.state_advance_cache._state is None  # nothing cached
+
+
+def test_timer_skips_stale_head():
+    h = _harness()
+    h.extend_chain(2)
+    timer = StateAdvanceTimer(h.chain)
+    cur = int(h.chain.head_state.slot)
+    # clock two slots ahead of the head: this slot's block is still in
+    # flight — a pre-advance off the old head could never be consumed
+    timer.on_slot_tick(cur + 2)
+    assert h.chain.state_advance_cache._state is None
+
+
+# ---------------------------------------------------------------------------
+# snapshot aliasing fuzz
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_mutation_never_leaks_into_head_state():
+    h = _harness()
+    h.extend_chain(3)
+    timer = StateAdvanceTimer(h.chain)
+    cur = int(h.chain.head_state.slot)
+    timer.on_slot_tick(cur)
+
+    head = h.chain.head_state
+    head_root_hash = head.hash_tree_root()
+    head_balances = [int(b) for b in head.balances]
+
+    rng = random.Random(0xA11A5)
+    for trial in range(4):
+        snap = h.chain.state_advance_cache.get(h.chain.head_root, cur + 1)
+        assert snap is not None and snap.slot == cur + 1
+        n = len(snap.balances)
+        # churn the snapshot through every mutation channel the CoW
+        # discipline tracks: balance writes, registry mutations (dirty
+        # channels), appends, and a re-hash that drains caches
+        for _ in range(20):
+            snap.balances[rng.randrange(n)] = rng.randrange(40_000_000_000)
+        v = snap.validators.mutate(rng.randrange(n))
+        v.slashed = True
+        v.withdrawable_epoch = 7
+        snap.balances.append(32_000_000_000)
+        snap.hash_tree_root()
+        # the head state saw none of it
+        assert [int(b) for b in head.balances] == head_balances, trial
+        assert not any(v.slashed for v in head.validators), trial
+        assert head.hash_tree_root() == head_root_hash, trial
+
+
+def test_head_mutation_never_leaks_into_snapshot():
+    h = _harness()
+    h.extend_chain(3)
+    timer = StateAdvanceTimer(h.chain)
+    cur = int(h.chain.head_state.slot)
+    timer.on_slot_tick(cur)
+
+    snap = h.chain.state_advance_cache.get(h.chain.head_root, cur + 1)
+    snap_hash = snap.hash_tree_root()
+    snap_balances = [int(b) for b in snap.balances]
+
+    head = h.chain.head_state
+    rng = random.Random(0x5EED)
+    for _ in range(20):
+        head.balances[rng.randrange(len(head.balances))] = rng.randrange(
+            40_000_000_000
+        )
+    head.validators.mutate(0).slashed = True
+    head.hash_tree_root()
+
+    assert [int(b) for b in snap.balances] == snap_balances
+    assert not snap.validators[0].slashed
+    assert snap.hash_tree_root() == snap_hash
+    # and a FRESH copy from the still-cached entry is equally unpolluted
+    snap2 = h.chain.state_advance_cache.get(h.chain.head_root, cur + 1)
+    assert snap2.hash_tree_root() == snap_hash

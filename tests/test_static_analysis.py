@@ -186,6 +186,57 @@ def test_safe_arith_das_index_vocab_scoped_to_das():
     assert lint_source(outside, SP) == []
 
 
+# a synthetic path matching beacon_chain/state_advance.py — in the
+# safe-arith scope since the proposer pipeline (PR 17: the pre-advance
+# drives per_slot_processing over the same uint64 state quantities the
+# epoch sweeps mutate). The scope binds to the FILE, not beacon_chain/.
+SA = "lighthouse_tpu/beacon_chain/state_advance_fixture.py"
+BC = "lighthouse_tpu/beacon_chain/_fixture.py"
+
+
+def test_safe_arith_fires_in_state_advance():
+    bad = (
+        "def f(state, index, fee):\n"
+        "    balance = state.balances[index]\n"
+        "    return balance - fee\n"
+    )
+    assert _rules(lint_source(bad, SA)) == ["safe-arith"]
+
+
+def test_safe_arith_state_advance_clean_through_helpers():
+    good = (
+        "from lighthouse_tpu.utils.safe_arith import safe_sub\n"
+        "def f(state, index, fee):\n"
+        "    balance = state.balances[index]\n"
+        "    return safe_sub(balance, fee)\n"
+    )
+    assert lint_source(good, SA) == []
+
+
+def test_metric_hygiene_fires_in_state_advance():
+    # metric-hygiene is package-wide, so the new module is covered like
+    # any other — the real file's loop-registered span names carry an
+    # allow at the registration site; a dynamic name here must fire
+    bad = (
+        "from lighthouse_tpu.metrics import inc_counter\n"
+        "def f(stage):\n"
+        "    inc_counter(f'state_advance_{stage}_total')\n"
+    )
+    assert _rules(lint_source(bad, SA)) == ["metric-hygiene"]
+
+
+def test_safe_arith_scope_is_state_advance_not_beacon_chain():
+    # chain.py and friends stay out of scope — only the advance module
+    # (which runs the slot/epoch transitions) carries the rule
+    outside = (
+        "def f(state, index, fee):\n"
+        "    balance = state.balances[index]\n"
+        "    return balance - fee\n"
+    )
+    assert lint_source(outside, BC) == []
+    assert lint_source(outside, OUT) == []
+
+
 def test_fork_safety_fires_on_das_shaped_worker():
     # das/proofs.py keeps its pool workers (_msm_shard/_prove_shard)
     # metrics-free for exactly this rule: counters are parent-side only

@@ -40,7 +40,9 @@ from lighthouse_tpu.testing.testnet import (
     run_equivocation_scenario,
     run_gossip_flood_scenario,
     run_late_delivery_scenario,
+    run_late_proposer_scenario,
     run_partition_heal_scenario,
+    run_production_under_flood_scenario,
     run_smoke_scenario,
     scenario_seed,
 )
@@ -217,6 +219,18 @@ def test_column_withholding_refusal_then_recovery():
     # the fault fleet counted the injections
     assert _counter("testnet_fault_injections_total", kind="withhold") >= 2
     assert _counter("das_reconstructions_total") >= 1
+
+
+def test_late_proposer_reorged_out_while_finality_advances():
+    """The proposer-boost re-org regime on 4 real nodes: a block
+    withheld past the attestation deadline loses its committee (they
+    attest the parent — same-slot gossip votes carried by the fork
+    choice deferral queue), and the next slot's proposer builds on the
+    parent, orphaning it while the fleet single-heads and finalizes."""
+    report = run_late_proposer_scenario(_spec(), E)
+    assert report["deferred_applied"] > 0
+    assert min(report["finalized"]) >= 1
+    assert report["recovery_slots"] <= 6 * E.SLOTS_PER_EPOCH
 
 
 # -- directed regressions: SyncService status-poll discipline ------------------
@@ -489,4 +503,16 @@ def test_gossip_flood_sheds_and_finalizes():
     report = run_gossip_flood_scenario(_spec(), E)
     assert report["flood_sent"] > 0
     assert any(v > 0 for v in report["shed"].values())
+    assert min(report["finalized"]) >= 1
+
+
+@pytest.mark.slow
+def test_block_production_bounded_under_flood():
+    """Proposals keep landing — and the block_production trace root
+    keeps a bounded mean — while attacker nodes flood the gossip lanes
+    the production pipeline shares workers with."""
+    report = run_production_under_flood_scenario(_spec(), E)
+    assert report["flood_sent"] > 0
+    assert report["blocks_published"] > 0
+    assert report["mean_production_ms"] <= 1000.0
     assert min(report["finalized"]) >= 1
