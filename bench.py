@@ -1648,8 +1648,18 @@ def bench_testnet_soak(jax):
     validators = 24 if SMOKE else 40
     soak_epochs = 3 if SMOKE else 5
     cycles = 1 if SMOKE else 2
+    # BENCH_TESTNET_API_WORKERS=N boots every full node's Beacon API with
+    # N forked serving workers (PR 18) — the A/B lever: a soak at 0 vs a
+    # soak at 2 through --compare proves the serving tier doesn't tax the
+    # chain's finalization rate
+    api_workers = int(os.environ.get("BENCH_TESTNET_API_WORKERS", "0") or 0)
     net = Testnet.create(
-        spec, E, node_count=nodes, validator_count=validators, seed=2026
+        spec,
+        E,
+        node_count=nodes,
+        validator_count=validators,
+        seed=2026,
+        api_workers=api_workers,
     )
     rates, recoveries, convergences, recovery_slots = [], [], [], []
     try:
@@ -1716,6 +1726,7 @@ def bench_testnet_soak(jax):
             "validators": validators,
             "soak_epochs": soak_epochs,
             "partition_heal_cycles": cycles,
+            "api_workers": api_workers,
             "seed": net.seed,
             "spec": "minimal",
         },
@@ -2394,6 +2405,181 @@ def bench_api_throughput(jax):
     ssz_ms = (time.perf_counter() - t0) * 1000
     assert len(ssz_body) == n * 16
 
+    # -- multi-process serving workers (PR 18): the same columns behind
+    # the pre-fork accept tier, measured through real HTTP ---------------
+    import hashlib
+    import threading
+    import urllib.request
+
+    from lighthouse_tpu.http_api import HttpApiServer
+
+    cores = os.cpu_count() or 1
+    client_threads = 4 if SMOKE else 8
+    load_s = 2.0 if SMOKE else 4.0
+    page_offsets = (0, (n // 2 // page) * page, ((n - page) // page) * page)
+    table_path = "/eth/v1/beacon/states/head/validators"
+
+    def _digest_get(port, path):
+        """(headers, sha256, size) — streamed, so full-table bodies never
+        pile up in client memory."""
+        req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            hasher = hashlib.sha256()
+            size = 0
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                hasher.update(chunk)
+                size += len(chunk)
+            return dict(r.headers), hasher.hexdigest(), size
+
+    def _load(port, seconds):
+        """Concurrent paginated-page GETs (the small-body dashboard
+        workload — full-table transfers would measure loopback bandwidth,
+        not the serving tier) for `seconds`; returns (req/sec, errors)."""
+        stop_at = time.perf_counter() + seconds
+        counts = [0] * client_threads
+        errors = [0] * client_threads
+
+        def run(i):
+            k = i
+            while time.perf_counter() < stop_at:
+                off = page_offsets[k % len(page_offsets)]
+                k += 1
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{table_path}"
+                        f"?limit={page}&offset={off}",
+                        timeout=30,
+                    ) as r:
+                        r.read()
+                    counts[i] += 1
+                except Exception:  # noqa: BLE001 — tallied, asserted zero
+                    errors[i] += 1
+
+        threads = [
+            threading.Thread(target=run, args=(i,))
+            for i in range(client_threads)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return sum(counts) / wall, sum(errors)
+
+    def _burst_digests(port, names, attempts=10):
+        """Bursts of concurrent full-table GETs until every server id in
+        `names` has answered; {server_id: digest}. Concurrency is what
+        spreads the accepts — sequential requests can all land on one
+        replica."""
+        seen = {}
+        for _ in range(attempts):
+            results, faults = [], []
+
+            def one():
+                try:
+                    hd, dg, _ = _digest_get(port, table_path)
+                    results.append((hd["X-Api-Served-By"], dg))
+                except Exception as e:  # noqa: BLE001 — asserted below
+                    faults.append(e)
+
+            burst = [
+                threading.Thread(target=one)
+                for _ in range(min(client_threads, 4))
+            ]
+            for t in burst:
+                t.start()
+            for t in burst:
+                t.join()
+            assert not faults, f"full-table burst failed: {faults[0]!r}"
+            for who, dg in results:
+                seen[who] = dg
+            if names <= set(seen):
+                return seen
+        raise AssertionError(
+            f"server ids seen {sorted(seen)} never covered {sorted(names)}"
+        )
+
+    respawns = REGISTRY.counter("api_worker_respawns_total")
+    w_axis = {}
+    for W in (1, 4):
+        srv = HttpApiServer(chain, workers=W)
+        # prime the response cache BEFORE start(): the fork inherits the
+        # hot full-table body by CoW — every replica is born warm
+        srv.api.serve_state_validators("head")
+        srv.start()
+        try:
+            ready_by = time.monotonic() + 20
+            while True:
+                try:
+                    _digest_get(srv.port, "/eth/v1/node/health")
+                    break
+                except Exception:  # noqa: BLE001 — replicas still booting
+                    if time.monotonic() > ready_by:
+                        raise
+                    time.sleep(0.1)
+            rps, errs = _load(srv.port, load_s)
+            assert errs == 0, f"workers={W}: {errs} failed requests"
+            w_axis[W] = round(rps, 1)
+            _partial(workers=W, paginated_rps=w_axis[W])
+            if W == 4:
+                # full-table bodies byte-identical from EVERY replica
+                # (compared by streamed digest against the parent's serve)
+                _, parent_digest, parent_size = _digest_get(
+                    srv.parent_port, table_path
+                )
+                assert parent_size == len(body)
+                names = {w["name"] for w in srv._pool.worker_info()}
+                assert len(names) == 4
+                seen = _burst_digests(srv.port, names)
+                assert all(dg == parent_digest for dg in seen.values()), (
+                    "replica full-table body diverged from the parent"
+                )
+                # …and across a head-change invalidation: stale replicas
+                # forward to the parent, the supervisor rotates them onto
+                # a fresh CoW snapshot, and the bytes never waver
+                r_before = respawns.value(reason="head_refresh")
+                chain.event_handler.register_head(
+                    chain.head_root, int(state.slot), b"\x11" * 32
+                )
+                _, dg, _ = _digest_get(srv.port, table_path)
+                assert dg == parent_digest
+                rotate_by = time.monotonic() + 30
+                while respawns.value(reason="head_refresh") == r_before:
+                    assert time.monotonic() < rotate_by, (
+                        "head event never rotated the replicas"
+                    )
+                    time.sleep(0.1)
+                seen = _burst_digests(
+                    srv.port, {w["name"] for w in srv._pool.worker_info()}
+                )
+                assert all(dg == parent_digest for dg in seen.values()), (
+                    "post-rotation replica body diverged from the parent"
+                )
+                _partial(workers=4, identity="passed", rotations=int(
+                    respawns.value(reason="head_refresh") - r_before
+                ))
+        finally:
+            srv.stop()
+    speedup = round(w_axis[4] / w_axis[1], 2) if w_axis[1] else 0.0
+    if cores >= 4:
+        assert speedup >= 1.8, (
+            f"workers=4 speedup {speedup}x < 1.8x on {cores} cores"
+        )
+    else:
+        # a 1-core box cannot show parallel speedup; the floor asserts
+        # the tier doesn't grossly TAX throughput. Four processes
+        # time-slicing one core pay real scheduler overhead (~0.75-0.85x
+        # observed), hence 0.7, not 1.0
+        floor = float(os.environ.get("BENCH_API_WORKERS_MIN_RATIO", "0.7"))
+        assert speedup >= floor, (
+            f"workers=4 at {speedup}x of workers=1 on {cores} core(s) — "
+            f"below the no-regression floor {floor}"
+        )
+
     stages = _span_deltas(
         spans_before, _span_totals(("cache_lookup", "assemble", "serialize"))
     )
@@ -2432,10 +2618,232 @@ def bench_api_throughput(jax):
             ),
             "cache_hits": int(hits.value(route="validators") - hits_before),
             "differential_check": "passed",
+            "workers_axis": {
+                "cores": cores,
+                "client_threads": client_threads,
+                "workers1_rps": w_axis[1],
+                "workers4_rps": w_axis[4],
+                "speedup": speedup,
+                "full_table_identity": "passed",
+                "head_refresh_identity": "passed",
+            },
         },
+        "sub_metrics": [
+            {
+                "metric": "api_throughput_workers1",
+                "value": w_axis[1],
+                "unit": (
+                    f"req/sec (paginated pages via HTTP, workers=1, "
+                    f"{cores} cores)"
+                ),
+            },
+            {
+                "metric": "api_throughput_workers4",
+                "value": w_axis[4],
+                "unit": (
+                    f"req/sec (paginated pages via HTTP, workers=4, "
+                    f"{cores} cores)"
+                ),
+            },
+        ],
         "stages": stages,
         "spread": t_cold,
         "control_spread": t_oracle,
+    }
+
+
+def bench_sse_fanout(jax):
+    """The SSE broadcast fan-out tier (PR 18) at dashboard-fleet scale:
+    one handler, 10k subscribers, head events published at a paced
+    cadence (a burst would just measure queue backlog). Each event is
+    serialized ONCE and the shared frame lands on every matching
+    subscriber queue via the dedicated broadcast thread; sentinel drainer
+    threads measure publish→drain lag end to end. A separate phase proves
+    slow-consumer eviction is drop-counted, never blocking the publisher.
+    vs_baseline is the naive tier — re-serializing per subscriber —
+    measured over the same subscriber population, same run."""
+    import gc
+    import threading
+
+    from lighthouse_tpu.beacon_chain import events as ev_mod
+    from lighthouse_tpu.beacon_chain.events import (
+        EventSubscription,
+        ServerSentEventHandler,
+        sse_frame,
+    )
+    from lighthouse_tpu.metrics import REGISTRY
+
+    dropped = REGISTRY.counter("sse_dropped_total")
+    delivered = REGISTRY.counter("sse_events_delivered_total")
+    serialized = REGISTRY.counter("sse_events_serialized_total")
+    drop_reasons = ("slow_consumer", "evicted", "publish_overflow")
+
+    subs_small = 200 if SMOKE else 1000
+    subs_big = 1000 if SMOKE else 10_000
+    events_small = 50 if SMOKE else 200
+    events_big = 20 if SMOKE else 40
+    sentinels = 8
+    pace_small_s = 0.002
+    pace_big_s = 0.05
+    p99_cap_ms = float(os.environ.get("BENCH_SSE_P99_MS", "250"))
+
+    def publish(h, count, pace_s, start=0):
+        for i in range(count):
+            h.register_head(bytes([i % 256]) * 32, start + i, b"\x01" * 32)
+            if pace_s:
+                time.sleep(pace_s)
+
+    h = ServerSentEventHandler()
+
+    # -- phase 1: 1k subscribers, ZERO drops at paced head cadence -------
+    subs = [h.subscribe(["head"]) for _ in range(subs_small)]
+    drops_before = {r: dropped.value(reason=r) for r in drop_reasons}
+    ser_before = serialized.value()
+    publish(h, events_small, pace_small_s)
+    assert h.flush(60.0)
+    for r, v in drops_before.items():
+        assert dropped.value(reason=r) == v, f"phase-1 drops (reason={r})"
+    # serialize-once: one frame per EVENT, not per (event, subscriber)
+    assert serialized.value() - ser_before == events_small
+    # queue cap (256) above the event count: nothing displaced anywhere
+    for s in (subs[0], subs[len(subs) // 2], subs[-1]):
+        assert s._q.qsize() == events_small
+    for s in subs:
+        h.unsubscribe(s)
+    _partial(phase="zero_drops", subscribers=subs_small, events=events_small)
+    gc.collect()
+
+    # -- phase 2: 10k subscribers, sentinel-measured publish→drain lag ---
+    subs = [h.subscribe(["head"]) for _ in range(subs_big - sentinels)]
+    sentinel_subs = [h.subscribe(["head"]) for _ in range(sentinels)]
+    lags, lag_lock = [], threading.Lock()
+    stop = threading.Event()
+
+    def drain(sub):
+        local = []
+        while True:
+            rec = sub.poll_record(timeout=0.05)
+            if rec is not None:
+                local.append(time.monotonic() - rec[2])
+            elif stop.is_set():
+                break
+        with lag_lock:
+            lags.extend(local)
+
+    drainers = [
+        threading.Thread(target=drain, args=(s,)) for s in sentinel_subs
+    ]
+    for t in drainers:
+        t.start()
+    del_before = delivered.value()
+    drops_before = {r: dropped.value(reason=r) for r in drop_reasons}
+    t0 = time.perf_counter()
+    publish(h, events_big, pace_big_s, start=1000)
+    assert h.flush(120.0)
+    fan_wall = time.perf_counter() - t0
+    stop.set()
+    for t in drainers:
+        t.join(30.0)
+    deliveries = delivered.value() - del_before
+    assert deliveries == events_big * subs_big
+    rate = deliveries / fan_wall
+    for r, v in drops_before.items():
+        assert dropped.value(reason=r) == v, f"phase-2 drops (reason={r})"
+    assert len(lags) == events_big * sentinels
+    lags.sort()
+    lag_p50_ms = lags[len(lags) // 2] * 1000
+    lag_p99_ms = lags[int(len(lags) * 0.99)] * 1000
+    assert lag_p99_ms < p99_cap_ms, (
+        f"p99 publish→drain lag {lag_p99_ms:.1f} ms ≥ {p99_cap_ms} ms"
+    )
+    _partial(
+        phase="fanout",
+        subscribers=subs_big,
+        deliveries_per_sec=round(rate, 1),
+        p99_ms=round(lag_p99_ms, 2),
+    )
+
+    # -- control: the naive tier serializes per SUBSCRIBER ---------------
+    # (same population size, same _offer machinery, same run; the only
+    # difference is where sse_frame runs — the shared-frame economics)
+    ctrl = [EventSubscription(("head",)) for _ in range(subs_big)]
+    ev = {
+        "topic": "head",
+        "data": {
+            "slot": "1",
+            "block": "0x" + "ab" * 32,
+            "state": "0x" + "cd" * 32,
+        },
+    }
+    rounds = 3
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        for s in ctrl:
+            s._offer((ev, sse_frame(ev).encode(), t0))
+    naive_s = (time.perf_counter() - t0) / rounds
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        frame = sse_frame(ev).encode()
+        for s in ctrl:
+            s._offer((ev, frame, t0))
+    shared_s = (time.perf_counter() - t0) / rounds
+    vs_baseline = round(naive_s / shared_s, 2) if shared_s else 0.0
+    del ctrl
+    for s in subs + sentinel_subs:
+        h.unsubscribe(s)
+    gc.collect()
+
+    # -- phase 3: a wedged consumer is evicted, never blocks -------------
+    stuck = h.subscribe(["head"])
+    evict_before = dropped.value(reason="evicted")
+    slow_before = dropped.value(reason="slow_consumer")
+    t0 = time.perf_counter()
+    publish(h, ev_mod._QUEUE_CAP + ev_mod._EVICT_AFTER, 0.0, start=5000)
+    publish_wall = time.perf_counter() - t0
+    assert h.flush(60.0)
+    assert stuck.evicted and stuck.closed
+    assert dropped.value(reason="evicted") == evict_before + 1
+    slow_drops = dropped.value(reason="slow_consumer") - slow_before
+    assert slow_drops >= ev_mod._EVICT_AFTER
+    assert publish_wall < 5.0, (
+        f"publisher spent {publish_wall:.2f}s — it must never block on a "
+        "wedged consumer"
+    )
+    h.close()
+
+    return {
+        "metric": "sse_fanout",
+        "value": round(rate, 1),
+        "unit": (
+            f"deliveries/sec ({subs_big} subscribers, paced head events)"
+        ),
+        "vs_baseline": vs_baseline,
+        "baseline_control": (
+            "per-subscriber re-serialization (naive tier) over the same "
+            f"{subs_big}-subscriber population, same run — the shared-"
+            "frame economics"
+        ),
+        "config": {
+            "subscribers": subs_big,
+            "events": events_big,
+            "pace_ms": pace_big_s * 1000,
+            "sentinel_drainers": sentinels,
+            "lag_p50_ms": round(lag_p50_ms, 2),
+            "lag_p99_ms": round(lag_p99_ms, 2),
+            "p99_cap_ms": p99_cap_ms,
+            "zero_drop_phase": {
+                "subscribers": subs_small,
+                "events": events_small,
+                "drops": 0,
+            },
+            "eviction_phase": {
+                "slow_consumer_drops": int(slow_drops),
+                "evictions": 1,
+                "publish_wall_s": round(publish_wall, 3),
+            },
+            "queue_cap": ev_mod._QUEUE_CAP,
+            "evict_after": ev_mod._EVICT_AFTER,
+        },
     }
 
 
@@ -2460,6 +2868,7 @@ _METRICS = {
     "op_pool": bench_op_pool,
     "slasher_ingest": bench_slasher_ingest,
     "api_throughput": bench_api_throughput,
+    "sse_fanout": bench_sse_fanout,
 }
 
 
@@ -2640,8 +3049,14 @@ def main():
         "slasher_ingest": 240,
         # 1M fixture build + 3 cold full-table assemblies + 2 full-table
         # per-object oracle controls (those dominate) + hot/paginated
-        # sweeps; BENCH_TIMEOUT_API_THROUGHPUT overrides (0 = skip)
-        "api_throughput": 420,
+        # sweeps + the workers={1,4} forked-replica axis (two server
+        # boots, HTTP load, full-table digest bursts, a head-refresh
+        # rotation); BENCH_TIMEOUT_API_THROUGHPUT overrides (0 = skip)
+        "api_throughput": 540,
+        # pure-host fan-out: 1k/10k subscriber phases at paced cadence +
+        # the per-subscriber serialization control + the eviction phase;
+        # BENCH_TIMEOUT_SSE_FANOUT overrides (0 = skip)
+        "sse_fanout": 180,
     }
     for name, cap in secondary_caps.items():
         cap = _metric_cap(name, cap)
@@ -2676,6 +3091,11 @@ def _load_bench_entries(path: str) -> tuple[dict, bool]:
             and isinstance(e.get("value"), (int, float))
         ):
             entries[e["metric"]] = e
+            # axis sub-metrics (e.g. api_throughput_workers{1,4}) compare
+            # individually — each carries its own unit for direction
+            for s in e.get("sub_metrics", ()):
+                if isinstance(s, dict):
+                    add(s)
 
     add(raw)
     for d in raw.get("details", ()):

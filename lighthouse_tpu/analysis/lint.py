@@ -25,11 +25,13 @@ and milhouse `&mut` discipline, as a linter instead of a type system):
   `EpochArrays.write_snapshot_rows`).
 
 * ``fork-safety`` — callables submitted to the `parallel/host_pool`
-  fork pool run in children that inherit parent locks as-held: worker
-  functions (and their same-module callees, plus a one-hop import
-  resolve) must not touch the metrics registry, logging, tracing spans,
-  jax, or locks. Lambdas/closures can capture anything, so only
-  module-level functions are allowed.
+  fork pool, and entry functions passed to the serving-worker fork
+  entry (`http_api/workers.spawn_serving_worker`), run in children
+  that inherit parent locks as-held: worker functions (and their
+  same-module callees, plus a one-hop import resolve) must not touch
+  the metrics registry, logging, tracing spans, jax, or locks.
+  Lambdas/closures can capture anything, so only module-level
+  functions are allowed.
 
 * ``dirty-channel`` — `drain_dirty(name)` consumers must name their
   channel with a module-level constant that the same module registers /
@@ -132,6 +134,10 @@ _COLUMN_RECEIVERS = {"cols", "columns", "arrays", "cc", "cache"}
 # -- fork-safety vocabulary --------------------------------------------------
 
 _POOL_METHODS = {"map", "submit"}
+#: module-level functions whose FIRST positional argument is a forked
+#: serving-worker entrypoint (http_api/workers.spawn_serving_worker) —
+#: scanned with exactly the host_pool worker discipline
+_FORK_ENTRY_CALLS = {"spawn_serving_worker"}
 _FORBIDDEN_WORKER_NAMES = {
     "REGISTRY": "the metrics registry",
     "inc_counter": "the metrics registry",
@@ -670,14 +676,22 @@ def _check_fork_safety(tree: ast.Module, path: str) -> list[Violation]:
     imports = _imported_from(tree)
     ppath = Path(path)
     for node in ast.walk(tree):
-        if not (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        is_pool = (
+            isinstance(node.func, ast.Attribute)
             and node.func.attr in _POOL_METHODS
             and _mentions_pool(node.func.value)
-            and node.args
-        ):
+        )
+        is_entry = (
+            node.func.id in _FORK_ENTRY_CALLS
+            if isinstance(node.func, ast.Name)
+            else isinstance(node.func, ast.Attribute)
+            and node.func.attr in _FORK_ENTRY_CALLS
+        )
+        if not (is_pool or is_entry):
             continue
+        where = "the fork pool" if is_pool else "the serving-worker fork entry"
         worker = node.args[0]
         if isinstance(worker, ast.Lambda):
             out.append(
@@ -685,7 +699,7 @@ def _check_fork_safety(tree: ast.Module, path: str) -> list[Violation]:
                     path,
                     node.lineno,
                     "fork-safety",
-                    "lambda submitted to the fork pool — worker callables "
+                    f"lambda submitted to {where} — worker callables "
                     "must be module-level functions (closures capture "
                     "parent state, including locks)",
                 )

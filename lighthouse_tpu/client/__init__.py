@@ -31,6 +31,7 @@ class ClientConfig:
     db_path: str | None = None  # None = MemoryStore
     db_backend: str = "auto"  # auto | native (C++ LSM) | sqlite
     http_port: int | None = 0  # None = disabled
+    http_workers: int = 0  # 0 = single-process server; N = forked read replicas
     network_port: int | None = 0  # None = disabled
     noise: bool = False  # secure p2p streams with Noise XX
     noise_seed: bytes | None = None  # deterministic identity (tests)
@@ -257,7 +258,10 @@ class ClientBuilder:
             from ..http_api import HttpApiServer
 
             c.http_server = HttpApiServer(
-                c.chain, port=cfg.http_port, network=c.network
+                c.chain,
+                port=cfg.http_port,
+                network=c.network,
+                workers=cfg.http_workers,
             )
         # validator client (publishes over gossip when the node networks)
         if cfg.validate:

@@ -1477,6 +1477,10 @@ _ROUTES = [
 
 class _Handler(BaseHTTPRequestHandler):
     api: BeaconApi = None
+    #: set on the parent's handler when a worker tier is running — its
+    #: /metrics merges the per-process snapshots instead of exposing only
+    #: this process's registry
+    worker_pool = None
 
     def log_message(self, *args):  # quiet
         pass
@@ -1502,8 +1506,19 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _note_forward_demand(self):
+        """Serving-worker tier (PR 18): a replica forwarding a read
+        because it went generation-stale tags the request — that is the
+        pool's demand signal to rotate replicas onto a fresh CoW
+        snapshot. Only the parent (the forward target) ever sees the
+        header on a pool-owning handler."""
+        pool = self.worker_pool
+        if pool is not None and self.headers.get("X-Api-Forward-Why") == "stale":
+            pool.note_stale_forward()
+
     def do_GET(self):
         inc_counter("http_api_requests_total", method="GET")
+        self._note_forward_demand()
         parsed = urlparse(self.path)
         path = parsed.path
         if path == "/eth/v1/node/health":
@@ -1511,8 +1526,10 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             return
         if path == "/metrics":
+            pool = self.worker_pool
+            text = REGISTRY.expose() if pool is None else pool.merged_metrics()
             self._send_bytes(
-                REGISTRY.expose().encode(),
+                text.encode(),
                 content_type="text/plain; version=0.0.4",
             )
             return
@@ -1679,7 +1696,7 @@ class _Handler(BaseHTTPRequestHandler):
         (or `max_seconds`, a test convenience, elapses)."""
         import time as _time
 
-        from ..beacon_chain.events import ALL_TOPICS, sse_frame
+        from ..beacon_chain.events import ALL_TOPICS
 
         topics = query.get("topics", [",".join(ALL_TOPICS)])[0].split(",")
         try:
@@ -1695,10 +1712,14 @@ class _Handler(BaseHTTPRequestHandler):
         deadline = _time.monotonic() + max_seconds
         try:
             while _time.monotonic() < deadline:
-                ev = sub.poll(timeout=0.25)
-                if ev is None:
+                # frames arrive pre-serialized from the broadcast thread —
+                # one json.dumps per event regardless of subscriber count
+                frame = sub.poll_frame(timeout=0.25)
+                if frame is None:
+                    if sub.closed:
+                        break  # evicted as a slow consumer
                     continue
-                self.wfile.write(sse_frame(ev).encode())
+                self.wfile.write(frame)
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass  # client went away
@@ -1795,13 +1816,36 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class HttpApiServer:
-    """Threaded HTTP server bound to localhost (warp analog)."""
+    """Threaded HTTP server bound to localhost (warp analog).
 
-    def __init__(self, chain, port: int = 0, network=None):
+    `workers=0` (default) is the historical single-process server.
+    `workers=N` builds the multi-process read-replica tier (PR 18, see
+    `workers.py`): the public port's socket is bound pre-fork and N
+    worker processes accept on it, serving read-tier routes from their
+    CoW-shared warm state; this parent keeps a private full server on
+    `parent_port` that workers forward mutations, operator routes, SSE
+    streams, and stale reads to."""
+
+    def __init__(self, chain, port: int = 0, network=None, workers: int = 0):
         self.api = BeaconApi(chain, network=network)
+        self.workers = max(0, int(workers))
         handler = type("BoundHandler", (_Handler,), {"api": self.api})
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
-        self.port = self._server.server_address[1]
+        self._pool = None
+        self._public_sock = None
+        if self.workers == 0:
+            self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+            self.port = self._server.server_address[1]
+        else:
+            from .workers import ApiWorkerPool, bind_public_socket
+
+            self._public_sock = bind_public_socket(port)
+            self.port = self._public_sock.getsockname()[1]
+            self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+            self.parent_port = self._server.server_address[1]
+            self._pool = ApiWorkerPool(
+                self.api, self._public_sock, self.workers, self.parent_port
+            )
+            handler.worker_pool = self._pool
         self._thread = None
 
     def start(self):
@@ -1812,9 +1856,20 @@ class HttpApiServer:
             target=self._server.serve_forever, daemon=True, name="http_api"
         )
         self._thread.start()
+        if self._pool is not None:
+            # fork AFTER the parent server (and whatever the caller warmed
+            # through self.api) is live: CoW hands workers the columns,
+            # indexes, and any primed response-cache entries for free
+            self._pool.start()
         return self
 
     def stop(self):
+        if self._pool is not None:
+            self._pool.stop()
+            self._pool = None
         self._server.shutdown()
         self._server.server_close()
+        if self._public_sock is not None:
+            self._public_sock.close()
+            self._public_sock = None
         self.api.close()  # detach cache invalidation from the chain

@@ -241,3 +241,131 @@ def set_distribution(name: str, values, **labels):
 
 def start_timer(name: str) -> _Timer:
     return REGISTRY.histogram(name).start_timer()
+
+
+# -- multi-process serving tier (PR 18) ----------------------------------
+#
+# Forked API serving workers inherit this module's global REGISTRY as a
+# copy-on-write snapshot. Two consequences the helpers below absorb:
+#   1. inherited locks may be held by a parent thread that does not exist
+#      in the child → reset_locks_after_fork()
+#   2. the child's counters START at the parent's fork-time totals, so a
+#      naive sum across processes double-counts everything pre-fork →
+#      workers publish exposition_delta() snapshots and the scraping
+#      process stitches them with merge_expositions().
+
+
+def reset_locks_after_fork():
+    """Refresh registry/collector locks in a freshly forked child.
+
+    Safe only where host_pool's discipline already puts us: the child has
+    exactly one thread, so plain reassignment cannot race anything."""
+    REGISTRY._lock = threading.Lock()
+    for c in list(REGISTRY._collectors.values()):
+        c._lock = threading.Lock()
+
+
+def _parse_exposition(text: str):
+    """Parse a text exposition into ({collector: type}, {series line key:
+    (collector, value)}, first-seen key order).
+
+    The series key is the full left-hand side (`name{labels}`), which is
+    exactly the identity Prometheus uses, so merging on it is lossless."""
+    types: dict[str, str] = {}
+    series: dict[str, tuple[str, float]] = {}
+    order: list[str] = []
+    current = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                current = parts[2]
+                types[current] = parts[3]
+            continue
+        key, _, raw = line.rpartition(" ")
+        if not key:
+            continue
+        try:
+            v = float(raw)
+        except ValueError:
+            continue
+        name = key.split("{", 1)[0]
+        coll = current if current and name.startswith(current) else name
+        if key not in series:
+            order.append(key)
+        series[key] = (coll, v)
+    return types, series, order
+
+
+def merge_expositions(texts) -> str:
+    """Merge per-process text expositions into one scrape body.
+
+    Counters and histogram series SUM across processes (cumulative bucket
+    counts stay valid under addition); gauges keep the FIRST source that
+    exposes a given series — callers list the live/primary process first
+    so point-in-time values aren't summed into nonsense. Output groups
+    each collector under a single # TYPE line, collectors sorted by name
+    (Registry.expose parity) and series in first-seen order."""
+    types: dict[str, str] = {}
+    merged: dict[str, float] = {}
+    order: dict[str, list[str]] = {}
+    for text in texts:
+        t, series, keys = _parse_exposition(text)
+        for name, typ in t.items():
+            types.setdefault(name, typ)
+        for key in keys:
+            coll, v = series[key]
+            typ = types.get(coll, "gauge")
+            if key not in merged:
+                merged[key] = v
+                order.setdefault(coll, []).append(key)
+            elif typ in ("counter", "histogram"):
+                merged[key] += v
+            # gauge already present: first source wins
+    lines = []
+    for coll in sorted(order):
+        if coll in types:
+            lines.append(f"# TYPE {coll} {types[coll]}")
+        for key in order[coll]:
+            lines.append(f"{key} {_fmt_num(merged[key])}")
+    return "\n".join(lines) + "\n"
+
+
+def exposition_delta(current: str, baseline: str) -> str:
+    """Rewrite `current` with counter/histogram series reduced by their
+    `baseline` values.
+
+    A forked worker captures baseline = REGISTRY.expose() right after the
+    fork and publishes only what it accrued since, which is what makes
+    merge_expositions' sum correct. Gauges pass through untouched (they
+    are point-in-time, and the merge prefers the primary's anyway). A
+    series that shrank below its baseline (collector recreated post-fork)
+    is kept raw rather than clamped negative."""
+    c_types, _, _ = _parse_exposition(current)
+    _, b_series, _ = _parse_exposition(baseline)
+    out = []
+    coll = None
+    for line in current.splitlines():
+        s = line.strip()
+        if not s or s.startswith("#"):
+            parts = s.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                coll = parts[2]
+            out.append(line)
+            continue
+        key, _, raw = s.rpartition(" ")
+        if c_types.get(coll) in ("counter", "histogram") and key in b_series:
+            try:
+                v = float(raw)
+            except ValueError:
+                out.append(line)
+                continue
+            base = b_series[key][1]
+            nv = v - base if v >= base else v
+            out.append(f"{key} {_fmt_num(nv)}")
+        else:
+            out.append(line)
+    return "\n".join(out) + "\n"

@@ -94,16 +94,45 @@ def system_health(path: str = "/") -> SystemHealth:
     )
 
 
-def _proc_self_status_kb(field: str) -> int:
-    """One `VmXXX:` row of /proc/self/status in kB (0 where missing)."""
+def _proc_status_kb(field: str, pid: int | None = None) -> int:
+    """One `VmXXX:` row of /proc/<pid>/status in kB (0 where missing);
+    pid=None reads the calling process."""
+    path = f"/proc/{pid}/status" if pid is not None else "/proc/self/status"
     try:
-        with open("/proc/self/status") as f:
+        with open(path) as f:
             for line in f:
                 if line.startswith(field + ":"):
                     return int(line.split()[1])
     except (OSError, IndexError, ValueError):
         pass
     return 0
+
+
+def _proc_self_status_kb(field: str) -> int:
+    return _proc_status_kb(field)
+
+
+def _api_workers_block() -> dict | None:
+    """RSS of the forked API serving workers (PR 18), aggregated for the
+    `system` block: VmRSS alone reports only the calling process, but the
+    serving tier's footprint is parent + every replica, and the testnet
+    ChainHealthOracle's bounded-RSS invariant must see all of it. CoW
+    keeps per-worker RSS far below a full copy; divergence here is the
+    early-warning signal that shared pages are being dirtied."""
+    try:
+        from ..http_api.workers import live_worker_info
+    except Exception:  # noqa: BLE001 — keep health serving if the tier is absent
+        return None
+    info = live_worker_info()
+    if not info:
+        return None
+    for w in info:
+        w["rss_bytes"] = _proc_status_kb("VmRSS", w["pid"]) * 1024
+    return {
+        "count": len(info),
+        "rss_total_bytes": sum(w["rss_bytes"] for w in info),
+        "workers": info,
+    }
 
 
 def chain_health(chain) -> dict:
@@ -209,7 +238,14 @@ def process_health(chain=None) -> dict:
             "running": PROFILER.running,
             "samples": PROFILER.samples_total,
         },
-        "system": system_health().to_dict(),
+        "system": {
+            **system_health().to_dict(),
+            **(
+                {"api_workers": aw}
+                if (aw := _api_workers_block()) is not None
+                else {}
+            ),
+        },
     }
 
 

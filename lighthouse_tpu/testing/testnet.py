@@ -395,6 +395,7 @@ class Testnet:
     seed: int
     rng: random.Random
     kzg: str = "none"
+    api_workers: int = 0  # forked API read replicas per full node (PR 18)
     keypairs: list = field(default_factory=list)
     nodes: list[TestnetNode] = field(default_factory=list)
     attackers: list[TestnetNode] = field(default_factory=list)
@@ -420,6 +421,7 @@ class Testnet:
         sync_service_interval: float | None = 0.1,
         full_mesh_max: int = 12,
         kzg: str = "none",
+        api_workers: int = 0,
     ) -> "Testnet":
         """Boot `node_count` full nodes (ClientBuilder each: chain +
         fault-planed network + Beacon API + VC over a disjoint key share)
@@ -432,7 +434,7 @@ class Testnet:
         plane = FaultPlane()
         net = cls(
             spec=spec, E=E, plane=plane, seed=seed, rng=rng, kzg=kzg,
-            keypairs=keypairs,
+            api_workers=api_workers, keypairs=keypairs,
         )
         share = validator_count // node_count
         for i in range(node_count):
@@ -482,6 +484,9 @@ class Testnet:
             bls_backend=bls_backend,
             kzg=self.kzg,
             http_port=0,
+            # attackers keep the plain single-process server: the replica
+            # tier exists to scale honest serving, not scripted mischief
+            http_workers=0 if attacker else self.api_workers,
             network_port=0,
             manual_slot_clock=True,
             genesis_time=TESTNET_GENESIS_TIME,
@@ -957,6 +962,7 @@ class ChainHealthOracle:
         min_finalized_epoch: int | None = None,
         max_finalized_distance: int | None = None,
         max_reorg_depth: int | None = None,
+        max_rss_bytes: int | None = None,
         require_single_head: bool = False,
         zero_internal_errors: bool = True,
         what: str = "invariants",
@@ -970,8 +976,26 @@ class ChainHealthOracle:
         blocks = []
         heads = set()
         for node in nodes:
-            c = self.chain_block(node)
+            data = self.health(node)
+            if "chain" not in data:
+                raise ScenarioFailure(
+                    f"[seed={self.net.seed}] {node.name}: /lighthouse/health "
+                    "has no chain block"
+                )
+            c = data["chain"]
             blocks.append(c)
+            if max_rss_bytes is not None:
+                # the whole serving tier, not just the calling process:
+                # forked API workers report under system.api_workers
+                tier = data["rss_bytes"] + data["system"].get(
+                    "api_workers", {}
+                ).get("rss_total_bytes", 0)
+                if tier > max_rss_bytes:
+                    failures.append(
+                        f"{node.name}: serving-tier RSS {tier} > "
+                        f"{max_rss_bytes} (process {data['rss_bytes']}, "
+                        f"workers {tier - data['rss_bytes']})"
+                    )
             heads.add(c["head_root"])
             if max_head_lag is not None and c["head_lag_slots"] > max_head_lag:
                 failures.append(

@@ -66,6 +66,12 @@ def pytest_sessionstart(session):
         testnet,  # fault-injection/drop/delay counters + oracle outcomes
     )
     import lighthouse_tpu.das  # noqa: F401 — registers das_* series + spans
+    from lighthouse_tpu.beacon_chain import (  # noqa: F401 — registers
+        events,  # sse_* fan-out tier series
+    )
+    from lighthouse_tpu.http_api import (  # noqa: F401 — registers
+        workers,  # api_worker_* serving-replica series
+    )
 
     text = REGISTRY.expose()
     for needle in (
@@ -300,6 +306,26 @@ def pytest_sessionstart(session):
         'fork_choice_deferred_attestations_total{outcome="deferred"}',
         'fork_choice_deferred_attestations_total{outcome="applied"}',
         'fork_choice_deferred_attestations_total{outcome="dropped"}',
+        # PR 18: the SSE fan-out tier + serving-worker pool series must
+        # exist at zero — the sse_fanout bench differences the delivery/
+        # drop counters eagerly, and the worker supervisor's respawn and
+        # forwarding accounting is asserted by the lifecycle tests before
+        # any worker has ever forked
+        "sse_subscribers",
+        "sse_events_delivered_total",
+        "sse_events_serialized_total",
+        'sse_dropped_total{reason="slow_consumer"}',
+        'sse_dropped_total{reason="evicted"}',
+        'sse_dropped_total{reason="publish_overflow"}',
+        "api_worker_processes",
+        'api_worker_respawns_total{reason="death"}',
+        'api_worker_respawns_total{reason="head_refresh"}',
+        'api_worker_events_fanned_total{topic="head"}',
+        'api_worker_events_fanned_total{topic="block"}',
+        'api_worker_events_fanned_total{topic="finalized_checkpoint"}',
+        "api_worker_fan_drops_total",
+        'api_worker_requests_forwarded_total{why="stale"}',
+        'api_worker_requests_forwarded_total{why="proxy_route"}',
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"

@@ -1,0 +1,279 @@
+"""Multi-process Beacon API serving workers (http_api/workers.py, PR 18).
+
+The read-replica tier end to end over real forks: N workers accepting on
+the ONE pre-fork-bound public socket, read-tier routes served from each
+worker's CoW snapshot (byte-identical to the parent's answer), mutations
+and operator routes forwarded to the parent, the head-event generation
+guard (a stale worker must never serve a pre-head body), crash respawn,
+merged cross-process /metrics, health RSS aggregation, and a leak-free
+stop()."""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.http_api import HttpApiServer
+from lighthouse_tpu.metrics import REGISTRY
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.eth_spec import MinimalEthSpec
+
+E = MinimalEthSpec
+FULL_TABLE = "/eth/v1/beacon/states/head/validators"
+
+
+def _get(port, path, timeout=10):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+@pytest.fixture(scope="module")
+def rig():
+    prev = bls.backend_name()
+    bls.set_backend("fake_crypto")
+    h = BeaconChainHarness(minimal_spec(), E, validator_count=16)
+    h.extend_chain(4)
+    srv = HttpApiServer(h.chain, workers=2)
+    # tests trigger head changes back to back; don't make them wait out
+    # the production rotation coalescing window
+    srv._pool.respawn_min_interval = 0.05
+    srv.start()
+    yield h, srv
+    srv.stop()
+    bls.set_backend(prev)
+
+
+def _served_by(port, path, want, attempts=400):
+    """Issue GETs until every server id in `want` has answered at least
+    once (kernel accept balancing is not deterministic); returns
+    {server_id: body}."""
+    seen = {}
+    for _ in range(attempts):
+        _, hdr, body = _get(port, path)
+        seen[hdr["X-Api-Served-By"]] = body
+        if set(want) <= set(seen):
+            return seen
+    raise AssertionError(f"server ids seen {set(seen)} never covered {want}")
+
+
+def _wait(pred, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_both_workers_serve_read_tier_locally(rig):
+    h, srv = rig
+    bodies = _served_by(srv.port, FULL_TABLE, {"http_api-w0", "http_api-w1"})
+    # every replica answered from its own process, byte-identical
+    assert bodies["http_api-w0"] == bodies["http_api-w1"]
+
+
+def test_worker_bodies_byte_identical_to_parent(rig):
+    h, srv = rig
+    _, _, parent_body = _get(srv.parent_port, FULL_TABLE)
+    bodies = _served_by(srv.port, FULL_TABLE, {"http_api-w0", "http_api-w1"})
+    for name, body in bodies.items():
+        assert body == parent_body, f"{name} diverged from the parent body"
+
+
+def test_operator_routes_forward_to_parent(rig):
+    h, srv = rig
+    status, hdr, _ = _get(srv.port, "/eth/v1/node/version")
+    assert status == 200
+    assert hdr["X-Api-Served-By"] == "parent"
+    assert hdr["X-Api-Forwarded-By"].startswith("http_api-w")
+
+
+def test_posts_always_forward(rig):
+    h, srv = rig
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/eth/v1/beacon/pool/voluntary_exits",
+        data=b"not json",
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            hdr, status = dict(r.headers), r.status
+    except urllib.error.HTTPError as e:  # bad body → 4xx, still forwarded
+        hdr, status = dict(e.headers), e.code
+    assert status != 200
+    assert hdr["X-Api-Served-By"] == "parent"
+
+
+def test_head_change_generation_guard(rig):
+    """After a head event no response may carry the pre-head body — stale
+    workers forward to the parent until the rotation hands them a fresh
+    CoW snapshot, after which they serve locally again."""
+    h, srv = rig
+    pool = srv._pool
+    # the headers listing embeds the head root — any head move changes it
+    route = "/eth/v1/beacon/headers"
+    _, _, before_body = _get(srv.port, route)
+    pids_before = {w["pid"] for w in pool.worker_info()}
+    resp_before = REGISTRY.counter("api_worker_respawns_total").value(
+        reason="head_refresh"
+    )
+    h.extend_chain(1)
+    # bound on staleness detection: the generation heartbeat cadence
+    time.sleep(2 * pool.heartbeat_interval + 0.1)
+    _, _, parent_now = _get(srv.parent_port, route)
+    assert parent_now != before_body  # the head did change
+    for _ in range(30):
+        _, hdr, body = _get(srv.port, route)
+        assert body != before_body, (
+            f"pre-head body served by {hdr['X-Api-Served-By']} after the "
+            "head event — the generation guard leaked a stale read"
+        )
+        assert body == parent_now
+    # the supervisor rotates stale workers off the old snapshot…
+    assert _wait(
+        lambda: REGISTRY.counter("api_worker_respawns_total").value(
+            reason="head_refresh"
+        )
+        > resp_before
+    )
+    assert _wait(lambda: {w["pid"] for w in pool.worker_info()} != pids_before)
+    # …and the refreshed replicas serve the new head locally, byte-exact
+    names = {w["name"] for w in pool.worker_info()}
+    bodies = _served_by(srv.port, route, names)
+    for name, body in bodies.items():
+        assert body == parent_now, f"{name} served a stale post-rotation body"
+
+
+def test_merged_metrics_spans_processes(rig):
+    h, srv = rig
+    # the forwarded-request counters live in worker processes; their delta
+    # snapshots flow to the parent on the snapshot cadence
+    _get(srv.port, "/eth/v1/node/version")
+
+    def merged_has_forwards():
+        _, _, body = _get(srv.port, "/metrics")
+        text = body.decode()
+        assert "api_worker_processes 2" in text
+        for line in text.splitlines():
+            if line.startswith(
+                'api_worker_requests_forwarded_total{why="proxy_route"}'
+            ):
+                return float(line.rsplit(" ", 1)[1]) > 0
+        return False
+
+    assert _wait(merged_has_forwards, timeout=5.0)
+
+
+def test_health_aggregates_worker_rss(rig):
+    h, srv = rig
+    _, _, body = _get(srv.port, "/lighthouse/health")
+    data = json.loads(body)["data"]
+    aw = data["system"]["api_workers"]
+    assert aw["count"] == 2
+    assert aw["rss_total_bytes"] > 0
+    pids = {w["pid"] for w in aw["workers"]}
+    assert len(pids) == 2 and os.getpid() not in pids
+    assert pids == {w["pid"] for w in srv._pool.worker_info()}
+    assert all(w["rss_bytes"] > 0 for w in aw["workers"])
+
+
+def test_worker_death_respawns_and_serving_continues(rig):
+    h, srv = rig
+    pool = srv._pool
+    victim = pool.worker_info()[0]["pid"]
+    deaths = REGISTRY.counter("api_worker_respawns_total").value(reason="death")
+    os.kill(victim, signal.SIGKILL)
+    assert _wait(
+        lambda: REGISTRY.counter("api_worker_respawns_total").value(
+            reason="death"
+        )
+        == deaths + 1
+    )
+    assert _wait(
+        lambda: len(pool.worker_info()) == 2
+        and victim not in {w["pid"] for w in pool.worker_info()}
+    )
+    status, _, _ = _get(srv.port, FULL_TABLE)
+    assert status == 200
+    assert REGISTRY.gauge("api_worker_processes").value() == 2
+
+
+def test_sse_stream_relays_through_worker(rig):
+    h, srv = rig
+    url = f"http://127.0.0.1:{srv.port}/eth/v1/events?topics=head&max_seconds=3"
+    holder = {}
+
+    def read():
+        with urllib.request.urlopen(url, timeout=15) as r:
+            holder["hdr"] = dict(r.headers)
+            holder["body"] = r.read().decode()
+
+    t = threading.Thread(target=read, daemon=True)
+    t.start()
+    time.sleep(0.4)  # subscription established before the event fires
+    h.extend_chain(1)
+    assert h.chain.event_handler.flush(10.0)
+    t.join(20.0)
+    assert not t.is_alive()
+    # the stream is stateful: workers relay it to the parent's fan-out tier
+    assert holder["hdr"]["X-Api-Served-By"] == "parent"
+    assert holder["hdr"]["Content-Type"] == "text/event-stream"
+    assert "event: head" in holder["body"]
+
+
+def test_stop_leaves_zero_children_and_threads():
+    bls_prev = bls.backend_name()
+    bls.set_backend("fake_crypto")
+    try:
+        h = BeaconChainHarness(minimal_spec(), E, validator_count=8)
+        h.extend_chain(2)
+        sup_before = sum(
+            1
+            for t in threading.enumerate()
+            if t.name == "http_api-supervisor"
+        )
+        srv = HttpApiServer(h.chain, workers=2).start()
+        pids = [w["pid"] for w in srv._pool.worker_info()]
+        assert len(pids) == 2
+        status, _, _ = _get(srv.port, FULL_TABLE)
+        assert status == 200
+        srv.stop()
+        # every child reaped — a zombie or survivor would still have a pid
+        for pid in pids:
+            with pytest.raises(ProcessLookupError):
+                os.kill(pid, 0)
+        assert srv._pool is None
+        assert (
+            sum(
+                1
+                for t in threading.enumerate()
+                if t.name == "http_api-supervisor"
+            )
+            == sup_before
+        )
+    finally:
+        bls.set_backend(bls_prev)
+
+
+def test_single_process_mode_unchanged():
+    bls_prev = bls.backend_name()
+    bls.set_backend("fake_crypto")
+    try:
+        h = BeaconChainHarness(minimal_spec(), E, validator_count=8)
+        h.extend_chain(2)
+        srv = HttpApiServer(h.chain, workers=0).start()
+        try:
+            status, hdr, _ = _get(srv.port, FULL_TABLE)
+            assert status == 200
+            assert "X-Api-Served-By" not in hdr
+        finally:
+            srv.stop()
+    finally:
+        bls.set_backend(bls_prev)

@@ -39,6 +39,9 @@ def test_sse_events_block_head_finalized():
     h = _harness()
     sub = h.chain.event_handler.subscribe([TOPIC_BLOCK, TOPIC_HEAD, TOPIC_FINALIZED])
     h.extend_chain(4 * E.SLOTS_PER_EPOCH)
+    # delivery rides the broadcast thread: flush() is the happens-before
+    # edge between publishing and draining
+    assert h.chain.event_handler.flush(10.0)
     events = sub.drain()
     topics = [e["topic"] for e in events]
     assert topics.count(TOPIC_BLOCK) == 4 * E.SLOTS_PER_EPOCH

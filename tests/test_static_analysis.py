@@ -378,6 +378,65 @@ def test_fork_safety_fires_on_lambda():
     assert _rules(lint_source(bad, OUT)) == ["fork-safety"]
 
 
+def test_fork_safety_fires_on_serving_worker_entry():
+    """PR 18: `spawn_serving_worker(entry, ctx)` forks exactly like a
+    pool submit — the entry function is held to the same lock-free bar."""
+    bad = (
+        "from ..metrics import inc_counter\n"
+        "from .workers import spawn_serving_worker\n"
+        "def _entry(ctx):\n"
+        "    inc_counter('serves')\n"
+        "    return 0\n"
+        "def boot(ctx):\n"
+        "    return spawn_serving_worker(_entry, ctx)\n"
+    )
+    v = lint_source(bad, OUT)
+    assert _rules(v) == ["fork-safety"]
+    assert "inc_counter" in v[0].message
+
+
+def test_fork_safety_fires_on_serving_worker_entry_via_callee():
+    bad = (
+        "import logging\n"
+        "def _inner(ctx):\n"
+        "    logging.info('serving %s', ctx)\n"
+        "def _entry(ctx):\n"
+        "    return _inner(ctx)\n"
+        "def boot(workers, ctx):\n"
+        "    return workers.spawn_serving_worker(_entry, ctx)\n"
+    )
+    assert "fork-safety" in _rules(lint_source(bad, OUT))
+
+
+def test_fork_safety_fires_on_serving_worker_lambda_entry():
+    bad = (
+        "def boot(ctx):\n"
+        "    return spawn_serving_worker(lambda c: c.run(), ctx)\n"
+    )
+    v = lint_source(bad, OUT)
+    assert _rules(v) == ["fork-safety"]
+    assert "serving-worker fork entry" in v[0].message
+
+
+def test_fork_safety_clean_serving_worker_delegate_entry():
+    """The sanctioned shape (workers._serving_worker_main): the entry
+    re-initializes then delegates into a runtime object — nothing the
+    scanner flags runs before the child has replaced inherited state."""
+    good = (
+        "from .workers import spawn_serving_worker\n"
+        "class _Runtime:\n"
+        "    def __init__(self, ctx):\n"
+        "        self.ctx = ctx\n"
+        "    def run(self):\n"
+        "        return 0\n"
+        "def _entry(ctx):\n"
+        "    return _Runtime(ctx).run()\n"
+        "def boot(ctx):\n"
+        "    return spawn_serving_worker(_entry, ctx)\n"
+    )
+    assert lint_source(good, OUT) == []
+
+
 def test_fork_safety_resolves_workers_across_one_import_hop(tmp_path):
     """`pool.map(worker, ...)` where `worker` is imported from a sibling
     module: the linter must follow the ImportFrom and scan the worker in
