@@ -109,6 +109,18 @@ _OP_GLYPH = {ast.Add: "+", ast.Sub: "-", ast.Mult: "*", ast.FloorDiv: "//"}
 _DAS_U64_ATTRS = {"index"}
 _DAS_U64_PRODUCER_CALLS = {"cell_point_index", "column_subnet"}
 
+# validator_client/-scoped additions (PR 19): the VC duty cycle is
+# epoch/slot bookkeeping end to end — duty slots, checkpoint epochs, and
+# the slashing-protection watermark epochs are uint64 wire quantities.
+# Scoped to validator_client/ only: `.slot` / `.epoch` are too generic
+# to taint globally (every SSZ container carries a slot), but inside the
+# VC every such read IS the consensus quantity.
+_VC_U64_ATTRS = {"slot", "epoch", "target_epoch", "source_epoch"}
+_VC_U64_PRODUCER_CALLS = {
+    "compute_epoch_at_slot",
+    "compute_start_slot_at_epoch",
+}
+
 # -- cow-aliasing vocabulary -------------------------------------------------
 
 _VIEW_PRODUCER_CALLS = {"load_array", "committee_array"}
@@ -402,19 +414,27 @@ def _check_safe_arith(tree: ast.Module, path: str) -> list[Violation]:
     # state_advance.py joined with the proposer pipeline (PR 17): the
     # pre-advance drives per_slot_processing over the same uint64 state
     # quantities the epoch sweeps mutate.
+    # validator_client/ joined with the batched duty pipeline (PR 19),
+    # with its own epoch/slot vocabulary (see _VC_U64_ATTRS).
     das_scoped = "lighthouse_tpu/das" in p
+    vc_scoped = "lighthouse_tpu/validator_client" in p
     if (
         "state_processing" not in p
         and "fork_choice" not in p
         and "slasher" not in p
         and "state_advance" not in p
         and not das_scoped
+        and not vc_scoped
     ):
         return []
-    extra_attrs = frozenset(_DAS_U64_ATTRS) if das_scoped else frozenset()
-    extra_producers = (
-        frozenset(_DAS_U64_PRODUCER_CALLS) if das_scoped else frozenset()
-    )
+    extra_attrs = frozenset()
+    extra_producers = frozenset()
+    if das_scoped:
+        extra_attrs |= frozenset(_DAS_U64_ATTRS)
+        extra_producers |= frozenset(_DAS_U64_PRODUCER_CALLS)
+    if vc_scoped:
+        extra_attrs |= frozenset(_VC_U64_ATTRS)
+        extra_producers |= frozenset(_VC_U64_PRODUCER_CALLS)
 
     def is_source(node, tainted):
         return _is_u64_source(node, tainted, extra_attrs, extra_producers)

@@ -21,6 +21,9 @@ from .curve import (
     g1_in_subgroup,
     g1_is_on_curve,
     g1_to_bytes,
+    batch_inv,
+    batch_to_affine,
+    g2_affine_to_bytes,
     g2_clear_cofactor,
     g2_from_bytes,
     g2_in_subgroup,
@@ -38,6 +41,7 @@ from .curve import (
     to_affine,
 )
 from .fields import P, R, X
+from .fixed_base import FixedBaseTable, fixed_base_window, fixed_base_worthwhile
 from .hash_to_curve import DST_G2_POP, hash_to_g2
 from .msm import msm, msm_naive
 from .pairing import multi_pairing, pairing, pairing_check
@@ -45,9 +49,11 @@ from .pairing import multi_pairing, pairing, pairing_check
 __all__ = [
     "P", "R", "X", "B1", "B2", "FQ", "FQ2", "G1_GEN", "G2_GEN", "H1", "H2",
     "g1_from_bytes", "g1_gen_mul", "g1_in_subgroup", "g1_is_on_curve",
-    "g1_to_bytes", "g2_clear_cofactor", "g2_from_bytes", "g2_in_subgroup",
+    "g1_to_bytes", "batch_inv", "batch_to_affine", "g2_affine_to_bytes",
+    "g2_clear_cofactor", "g2_from_bytes", "g2_in_subgroup",
     "g2_is_on_curve", "g2_psi", "g2_to_bytes", "inf", "is_inf", "pt_add",
     "pt_double", "pt_eq", "pt_mul", "pt_mul_binary", "pt_neg", "to_affine",
     "DST_G2_POP", "hash_to_g2", "msm", "msm_naive", "multi_pairing",
-    "pairing", "pairing_check",
+    "pairing", "pairing_check", "FixedBaseTable", "fixed_base_window",
+    "fixed_base_worthwhile",
 ]

@@ -97,6 +97,38 @@ def to_affine(k: FieldOps, pt):
     return (k.mul(x, zi2), k.mul(y, k.mul(zi2, zi)))
 
 
+def batch_inv(k: FieldOps, vals):
+    """Montgomery batch inversion: n field inverses for ONE `k.inv` plus
+    3(n−1) multiplications. `vals` must be non-zero."""
+    prefix = []
+    acc = k.one
+    for v in vals:
+        acc = k.mul(acc, v)
+        prefix.append(acc)
+    inv_acc = k.inv(acc)
+    out = [None] * len(vals)
+    for i in range(len(vals) - 1, 0, -1):
+        out[i] = k.mul(inv_acc, prefix[i - 1])
+        inv_acc = k.mul(inv_acc, vals[i])
+    out[0] = inv_acc
+    return out
+
+
+def batch_to_affine(k: FieldOps, pts):
+    """`to_affine` over many Jacobian points with ONE field inversion
+    (Montgomery batch trick) — identical outputs, so serializations of
+    the results are bit-identical to the per-point path. Infinities map
+    to None, exactly like `to_affine`."""
+    nz = [i for i, pt in enumerate(pts) if not k.is_zero(pt[2])]
+    invs = batch_inv(k, [pts[i][2] for i in nz])
+    out = [None] * len(pts)
+    for i, zi in zip(nz, invs):
+        x, y, _z = pts[i]
+        zi2 = k.sqr(zi)
+        out[i] = (k.mul(x, zi2), k.mul(y, k.mul(zi2, zi)))
+    return out
+
+
 def from_affine(k: FieldOps, aff):
     if aff is None:
         return inf(k)
@@ -417,7 +449,13 @@ def g1_from_bytes(data: bytes):
 
 
 def g2_to_bytes(pt) -> bytes:
-    aff = to_affine(FQ2, pt)
+    return g2_affine_to_bytes(to_affine(FQ2, pt))
+
+
+def g2_affine_to_bytes(aff) -> bytes:
+    """Compress an affine G2 point ((x, y) or None for infinity) — the
+    serialization half of `g2_to_bytes`, split out so batch signers can
+    normalize many points with one `batch_to_affine` inversion first."""
     if aff is None:
         out = bytearray(96)
         out[0] = _COMPRESSED | _INFINITY

@@ -1211,34 +1211,47 @@ class BeaconApi:
         return anc == root
 
     def attester_duties(self, epoch: int, indices: list[int]):
-        """POST /eth/v1/validator/duties/attester/{epoch}."""
-        from ..state_processing.accessors import committee_cache_at
+        """POST /eth/v1/validator/duties/attester/{epoch}.
+
+        Resolved through the epoch duty table (inverse shuffling +
+        searchsorted over committee starts): one vectorized lookup over
+        the requested indices instead of the seed's walk over every
+        committee member of the epoch. Output rows keep the scan order
+        (slot, committee, position) the Beacon API tier always served."""
+        from ..state_processing.accessors import epoch_duty_table
 
         chain = self.chain
         st = chain.head_state
-        wanted = {int(i) for i in indices}
+        req = sorted({int(i) for i in indices})
         try:
-            cc = committee_cache_at(st, int(epoch), chain.E)
+            table = epoch_duty_table(st, int(epoch), chain.E)
         except ValueError as e:
             raise ApiError(400, f"epoch out of range: {e}") from e
-        start = compute_start_slot_at_epoch(int(epoch), chain.E)
+        found, slots, cidx, pos, size = table.lookup(req)
+        hit = [vi for vi, f in zip(req, found) if f]
+        cps = table.committees_per_slot
+        cols = self._columns_for(st)
+        rows = sorted(
+            zip(slots.tolist(), cidx.tolist(), pos.tolist(), size.tolist(), hit)
+        )
         duties = []
-        for slot in range(start, start + chain.E.SLOTS_PER_EPOCH):
-            for index in range(cc.committees_per_slot):
-                committee = cc.committee(slot, index)
-                for pos, vi in enumerate(committee):
-                    if vi in wanted:
-                        duties.append(
-                            {
-                                "pubkey": _hex(st.validators[vi].pubkey),
-                                "validator_index": str(vi),
-                                "committee_index": str(index),
-                                "committee_length": str(len(committee)),
-                                "committees_at_slot": str(cc.committees_per_slot),
-                                "validator_committee_index": str(pos),
-                                "slot": str(slot),
-                            }
-                        )
+        for slot, index, p, length, vi in rows:
+            pk = (
+                bytes(cols.pubkeys[vi])
+                if cols is not None
+                else bytes(st.validators[vi].pubkey)
+            )
+            duties.append(
+                {
+                    "pubkey": _hex(pk),
+                    "validator_index": str(vi),
+                    "committee_index": str(index),
+                    "committee_length": str(length),
+                    "committees_at_slot": str(cps),
+                    "validator_committee_index": str(p),
+                    "slot": str(slot),
+                }
+            )
         return {
             "data": duties,
             "dependent_root": _hex(self._dependent_root(st, int(epoch))),

@@ -31,6 +31,8 @@ suite and the `api_throughput` bench's riding check.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..metrics import REGISTRY
@@ -286,6 +288,12 @@ _STATUS_BYTES = tuple(s.encode() for s in STATUSES)
 #: are prefix-stable, so one list serves every table size up to its len
 _IDX_PIECES: list[bytes] = []
 
+#: two concurrent cold requests racing the extend would interleave their
+#: appends and permanently corrupt the index→piece positions; the lock
+#: makes the grow single-flight (readers of the already-built prefix
+#: never block — list reads are atomic)
+_IDX_LOCK = threading.Lock()
+
 #: per-column hex piece caches: name -> ((id, stamp, rows), base ref,
 #: pieces). Single-slot per column name; the base ref keeps the keyed
 #: array's id from being reused while the entry lives.
@@ -294,9 +302,12 @@ _HEX_PIECES: dict[str, tuple[tuple, object, list]] = {}
 
 def _index_pieces(n: int) -> list[bytes]:
     if len(_IDX_PIECES) < n:
-        _IDX_PIECES.extend(
-            b'{"index":"%d","balance":"' % i for i in range(len(_IDX_PIECES), n)
-        )
+        with _IDX_LOCK:
+            if len(_IDX_PIECES) < n:
+                _IDX_PIECES.extend(
+                    b'{"index":"%d","balance":"' % i
+                    for i in range(len(_IDX_PIECES), n)
+                )
     return _IDX_PIECES
 
 

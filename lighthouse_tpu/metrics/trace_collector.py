@@ -43,6 +43,7 @@ ROOT_SPAN_NAMES = (
     "slasher_process",
     "da_verify",
     "block_production",
+    "vc_duty_cycle",
 )
 
 _RING_SIZE = int(os.environ.get("LIGHTHOUSE_TPU_TRACE_RING", "256"))

@@ -224,15 +224,77 @@ class CommitteeCache:
         return len(self.shuffled)
 
 
+class EpochDutyTable:
+    """A whole epoch's attester assignment inverted into arrays.
+
+    `CommitteeCache.shuffled` maps committee offsets → validator indices;
+    duties want the inverse (validator index → where it sits). One
+    scatter builds the inverse permutation, and because committees are
+    contiguous slices of `shuffled` with boundaries `n·g // count`, a
+    searchsorted over the boundary array recovers (slot, committee index,
+    position, committee size) for ANY set of validator indices in one
+    vectorized pass — the duties_service's per-position Python sweep
+    (8 slots × committees × members) becomes four array ops.
+    """
+
+    __slots__ = ("start_slot", "committees_per_slot", "_offset_of", "_starts")
+
+    def __init__(self, cc: CommitteeCache, start_slot: int, n_validators: int):
+        import numpy as np
+
+        n = len(cc.shuffled)
+        offset_of = np.full(n_validators, -1, dtype=np.int64)
+        offset_of[cc.shuffled] = np.arange(n, dtype=np.int64)
+        g = np.arange(cc.committee_count + 1, dtype=np.int64)
+        self._starts = n * g // cc.committee_count
+        self._offset_of = offset_of
+        self.start_slot = int(start_slot)
+        self.committees_per_slot = cc.committees_per_slot
+
+    def lookup(self, indices):
+        """(found_mask, slot, committee_index, position, committee_size)
+        int64 arrays over `indices` — rows where found_mask is False
+        (inactive or out-of-range validator) carry no duty this epoch;
+        the duty arrays are aligned to indices[found_mask]."""
+        import numpy as np
+
+        idx = np.asarray(indices, dtype=np.int64)
+        found = (idx >= 0) & (idx < self._offset_of.shape[0])
+        off = self._offset_of[np.where(found, idx, 0)]
+        found &= off >= 0
+        off = off[found]
+        g = np.searchsorted(self._starts, off, side="right") - 1
+        slot = self.start_slot + g // self.committees_per_slot
+        committee_index = g % self.committees_per_slot
+        position = off - self._starts[g]
+        size = self._starts[g + 1] - self._starts[g]
+        return found, slot, committee_index, position, size
+
+
+def epoch_duty_table(state, epoch: int, E) -> EpochDutyTable:
+    """The epoch's `EpochDutyTable`, cached on the state alongside its
+    committee caches (same epoch-range discipline)."""
+    caches = _caches(state)
+    dt = caches.duty_tables.get(epoch)
+    if dt is None:
+        cc = committee_cache_at(state, epoch, E)
+        dt = EpochDutyTable(
+            cc, compute_start_slot_at_epoch(epoch, E), len(state.validators)
+        )
+        caches.duty_tables[epoch] = dt
+    return dt
+
+
 class StateCaches:
     """Per-state transient caches (committee shufflings by epoch). Attached
     lazily to a BeaconState instance — the reference keeps these inside the
     state object (beacon_state/committee_cache)."""
 
-    __slots__ = ("committees",)
+    __slots__ = ("committees", "duty_tables")
 
     def __init__(self):
         self.committees: dict[int, CommitteeCache] = {}
+        self.duty_tables: dict[int, EpochDutyTable] = {}
 
 
 def _caches(state) -> StateCaches:

@@ -146,3 +146,6 @@ class BeaconNodeFallback:
 
     def publish_aggregates(self, signed_aggregates):
         return self.first_success("publish_aggregates", signed_aggregates)
+
+    def attester_duties(self, epoch: int, indices):
+        return self.first_success("attester_duties", epoch, indices)

@@ -44,15 +44,19 @@ class KeymanagerApi:
         if len(keystores) != len(passwords):
             raise ValueError("keystores and passwords length mismatch")
         statuses = []
+        # one set snapshot maintained incrementally: rebuilding it per
+        # item is quadratic in the batch, which bites at 10k-key imports
+        present = {bytes(pk) for pk in self.vc.store.pubkeys()}
         for ks_json, password in zip(keystores, passwords):
             try:
                 ks = Keystore.from_json(ks_json)
                 sk = bls.SecretKey(int.from_bytes(ks.decrypt(password), "big"))
-                pk = sk.public_key().to_bytes()
-                if bytes(pk) in set(self.vc.store.pubkeys()):
+                pk = bytes(sk.public_key().to_bytes())
+                if pk in present:
                     statuses.append({"status": "duplicate"})
                     continue
                 self.vc.store.add_validator(pk, LocalKeystoreSigner(sk))
+                present.add(pk)
                 statuses.append({"status": "imported"})
             except Exception as e:  # noqa: BLE001 — per-item contract
                 statuses.append({"status": "error", "message": str(e)})

@@ -142,6 +142,127 @@ class SlashingDatabase:
             )
             self._conn.commit()
 
+    # -- batched attestations (one transaction per slot) ----------------------
+
+    def _insert_attestation_rows(self, rows):
+        """Batch-insert seam, separated from the decision loop so the
+        crash-point test can interrupt between staging and commit."""
+        self._conn.executemany(
+            "INSERT INTO signed_attestations VALUES (?, ?, ?, ?)", rows
+        )
+
+    def check_and_insert_attestations_batch(self, entries) -> list:
+        """EIP-3076 checks for a whole slot's worth of attestations with
+        ONE transaction instead of one commit per key.
+
+        `entries` is [(pubkey, source_epoch, target_epoch, signing_root)].
+        Returns a per-entry status list — None (safe to sign: fresh insert
+        or idempotent same-root re-sign) or a NotSafe instance refusing
+        ONLY that entry — equal to what sequential per-key
+        `check_and_insert_attestation` calls in entry order would produce:
+        an accepted entry is visible to later entries of the same batch
+        exactly as its sequential commit would have been. History is
+        preloaded in one whole-table pass (no per-key SELECTs, no IN-list
+        size limits — the DB holds only this VC's keys), decisions run in
+        Python against the preloaded view plus staged inserts, and
+        accepted rows land in one transaction: any exception mid-batch
+        rolls the DB back to the pre-batch watermark."""
+        entries = list(entries)
+        statuses: list = [None] * len(entries)
+        with self._lock:
+            vids = {
+                pk: vid
+                for pk, vid in self._conn.execute(
+                    "SELECT pubkey, id FROM validators"
+                )
+            }
+            batch_vids = set()
+            for pubkey, _s, _t, _root in entries:
+                vid = vids.get(bytes(pubkey))
+                if vid is not None:
+                    batch_vids.add(vid)
+            # vid -> (target -> root, [(source, target)], max_target)
+            by_target: dict[int, dict] = {}
+            spans: dict[int, list] = {}
+            max_target: dict[int, int] = {}
+            for vid, s, t, root in self._conn.execute(
+                "SELECT validator_id, source_epoch, target_epoch, "
+                "signing_root FROM signed_attestations"
+            ):
+                if vid not in batch_vids:
+                    continue
+                by_target.setdefault(vid, {})[t] = root
+                spans.setdefault(vid, []).append((s, t))
+                if t > max_target.get(vid, -1):
+                    max_target[vid] = t
+            rows = []
+            for i, (pubkey, source, target, signing_root) in enumerate(
+                entries
+            ):
+                if source > target:
+                    statuses[i] = NotSafe("attestation source > target")
+                    continue
+                vid = vids.get(bytes(pubkey))
+                if vid is None:
+                    statuses[i] = NotSafe(
+                        f"unregistered validator {bytes(pubkey).hex()[:16]}"
+                    )
+                    continue
+                root = bytes(signing_root)
+                seen = by_target.setdefault(vid, {})
+                prev = seen.get(target)
+                if prev is not None:
+                    if prev != root:
+                        statuses[i] = NotSafe(
+                            f"double vote at target {target}"
+                        )
+                    continue  # same root: idempotent, nothing to insert
+                surrounding = next(
+                    (
+                        st
+                        for st in spans.get(vid, ())
+                        if source < st[0] and st[1] < target
+                    ),
+                    None,
+                )
+                if surrounding is not None:
+                    statuses[i] = NotSafe(
+                        f"surrounds existing vote {surrounding}"
+                    )
+                    continue
+                surrounded = next(
+                    (
+                        st
+                        for st in spans.get(vid, ())
+                        if st[0] < source and target < st[1]
+                    ),
+                    None,
+                )
+                if surrounded is not None:
+                    statuses[i] = NotSafe(
+                        f"surrounded by existing vote {surrounded}"
+                    )
+                    continue
+                bound = max_target.get(vid)
+                if bound is not None and target <= bound:
+                    statuses[i] = NotSafe(
+                        f"target {target} <= min safe target {bound + 1}"
+                    )
+                    continue
+                seen[target] = root
+                spans.setdefault(vid, []).append((source, target))
+                if target > max_target.get(vid, -1):
+                    max_target[vid] = target
+                rows.append((vid, source, target, root))
+            try:
+                if rows:
+                    self._insert_attestation_rows(rows)
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+        return statuses
+
     # -- interchange (EIP-3076 JSON) ------------------------------------------
 
     def export_interchange(self, genesis_validators_root: bytes) -> dict:

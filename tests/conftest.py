@@ -72,6 +72,9 @@ def pytest_sessionstart(session):
     from lighthouse_tpu.http_api import (  # noqa: F401 — registers
         workers,  # api_worker_* serving-replica series
     )
+    import lighthouse_tpu.validator_client  # noqa: F401 — registers vc_*
+    # counters + vc_duty_cycle stage spans (bls_sign_batch_total comes
+    # with the crypto.bls import above)
 
     text = REGISTRY.expose()
     for needle in (
@@ -326,6 +329,24 @@ def pytest_sessionstart(session):
         "api_worker_fan_drops_total",
         'api_worker_requests_forwarded_total{why="stale"}',
         'api_worker_requests_forwarded_total{why="proxy_route"}',
+        # PR 19: the batched VC duty pipeline — the vc_epoch_100k bench
+        # differences the publish/refusal counters and the sign-strategy
+        # split eagerly, and the vc_duty_cycle trace root + stage spans
+        # must exist at zero before any duty runs
+        "vc_attestations_published_total",
+        "vc_blocks_published_total",
+        "vc_aggregates_published_total",
+        "vc_sync_committee_messages_published_total",
+        "vc_slashing_protection_refusals_total",
+        'bls_sign_batch_total{path="fixed_base"}',
+        'bls_sign_batch_total{path="per_key"}',
+        'trace_collector_traces_total{root="vc_duty_cycle"}',
+        "trace_span_seconds_vc_duty_cycle",
+        "trace_span_seconds_vc_fetch",
+        "trace_span_seconds_vc_assemble",
+        "trace_span_seconds_vc_protect",
+        "trace_span_seconds_vc_sign_batch",
+        "trace_span_seconds_vc_publish",
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"

@@ -237,6 +237,59 @@ def test_safe_arith_scope_is_state_advance_not_beacon_chain():
     assert lint_source(outside, OUT) == []
 
 
+# a synthetic path inside validator_client/ — in the safe-arith scope
+# since the batched duty pipeline (PR 19: duty slots, checkpoint epochs,
+# and slashing-protection watermark epochs are uint64 wire quantities,
+# with an epoch/slot vocabulary scoped to the VC)
+VC = "lighthouse_tpu/validator_client/_fixture.py"
+
+
+def test_safe_arith_fires_on_vc_duty_slot_arithmetic():
+    bad = (
+        "def f(duty, lookahead):\n"
+        "    return duty.slot + lookahead\n"
+    )
+    assert _rules(lint_source(bad, VC)) == ["safe-arith"]
+
+
+def test_safe_arith_fires_on_vc_epoch_producer_taint():
+    bad = (
+        "def f(slot, E):\n"
+        "    start = compute_start_slot_at_epoch(slot, E)\n"
+        "    return start + E.SLOTS_PER_EPOCH\n"
+    )
+    assert _rules(lint_source(bad, VC)) == ["safe-arith"]
+
+
+def test_safe_arith_fires_on_vc_watermark_epochs():
+    bad = (
+        "def f(entry, prev):\n"
+        "    return entry.target_epoch - prev.source_epoch\n"
+    )
+    assert _rules(lint_source(bad, VC)) == ["safe-arith"]
+
+
+def test_safe_arith_vc_clean_when_routed_through_helpers():
+    good = (
+        "from lighthouse_tpu.utils.safe_arith import safe_add\n"
+        "def f(slot, E):\n"
+        "    start = compute_start_slot_at_epoch(slot, E)\n"
+        "    return safe_add(start, E.SLOTS_PER_EPOCH)\n"
+    )
+    assert lint_source(good, VC) == []
+
+
+def test_safe_arith_vc_slot_vocab_scoped_to_validator_client():
+    # `.slot` / `.epoch` are far too generic to taint globally (every
+    # SSZ container carries a slot) — the vocab binds to the VC only
+    outside = (
+        "def f(duty, lookahead):\n"
+        "    return duty.slot + lookahead\n"
+    )
+    assert lint_source(outside, OUT) == []
+    assert lint_source(outside, BC) == []
+
+
 def test_fork_safety_fires_on_das_shaped_worker():
     # das/proofs.py keeps its pool workers (_msm_shard/_prove_shard)
     # metrics-free for exactly this rule: counters are parent-side only
