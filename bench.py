@@ -1760,6 +1760,235 @@ def bench_testnet_soak(jax):
     }
 
 
+def bench_checkpoint_boot(jax):
+    """Peer checkpoint sync: wall seconds from a bare store to a serving
+    chain anchored on a live peer's finalized checkpoint — three HTTP
+    round-trips (finality_checkpoints, state SSZ, block SSZ), two local
+    tree-root verifications, and the chain boot. The backfill rate rides
+    along as a sub-metric: blocks/s filling history backward over the
+    RPC while the anchored chain serves forward."""
+    from dataclasses import replace
+
+    from lighthouse_tpu.beacon_chain.checkpoint_sync import checkpoint_boot
+    from lighthouse_tpu.beacon_chain.harness import (
+        HARNESS_GENESIS_TIME,
+        BeaconChainHarness,
+    )
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.http_api import HttpApiServer
+    from lighthouse_tpu.network import NetworkService
+    from lighthouse_tpu.store import HotColdDB, MemoryStore
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    S = E.SLOTS_PER_EPOCH
+    epochs = 4 if SMOKE else 8
+    h = BeaconChainHarness(spec, E, validator_count=16)
+    h.extend_chain(epochs * S)
+    anchor_slot = None
+    srv = HttpApiServer(h.chain).start()
+    na = NetworkService(h.chain).start()
+    boots, backfill_rates = [], []
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        trials = 2 if SMOKE else 3
+        for t in range(trials):
+            clock = ManualSlotClock(
+                genesis_time=HARNESS_GENESIS_TIME,
+                seconds_per_slot=spec.seconds_per_slot,
+            )
+            clock.set_slot(int(h.chain.head_state.slot))
+            t0 = time.perf_counter()
+            chain = checkpoint_boot(
+                url, HotColdDB(MemoryStore()), spec, E, slot_clock=clock
+            )
+            boots.append(time.perf_counter() - t0)
+            anchor_slot = int(chain.anchor_slot)
+            nb = NetworkService(chain).start()
+            try:
+                peer = nb.connect("127.0.0.1", na.port)
+                t1 = time.perf_counter()
+                stored = nb.sync.backfill(peer)
+                dt = time.perf_counter() - t1
+                if stored and dt > 0:
+                    backfill_rates.append(stored / dt)
+            finally:
+                nb.stop()
+            _partial(trial=t + 1, boot_s=round(boots[-1], 3))
+    finally:
+        na.stop()
+        srv.stop()
+    return {
+        "metric": "checkpoint_boot_s",
+        "value": round(statistics.median(boots), 3),
+        "unit": "s to anchored serving chain (fetch+verify+boot)",
+        "config": {
+            "source_epochs": epochs,
+            "anchor_slot": anchor_slot,
+            "validators": 16,
+            "trials": len(boots),
+            "spec": "minimal",
+        },
+        "sub_metrics": [
+            {
+                "metric": "checkpoint_backfill_blocks_per_s",
+                "value": round(statistics.median(backfill_rates), 1)
+                if backfill_rates
+                else 0,
+                "unit": "blocks/sec backfilled over RPC",
+            }
+        ],
+        "spread": {
+            "median_s": round(statistics.median(boots), 3),
+            "min_s": round(min(boots), 3),
+            "max_s": round(max(boots), 3),
+            "trials": len(boots),
+        },
+    }
+
+
+def bench_store_soak(jax):
+    """Hot-store growth slope with the finality migrator ON vs OFF (the
+    `migrator.enabled` A/B seam). With migration every finality advance
+    moves finalized blocks cold and prunes hot states, so the hot side's
+    byte count flattens after the first finalized epoch; with it off the
+    same chain grows the hot side linearly forever. Headline: hot-store
+    bytes/epoch over the post-finality tail with migration ON (lower is
+    better — the bound the churn-soak oracle enforces); the OFF slope
+    and the ON/OFF ratio ride along."""
+    from dataclasses import replace
+
+    from lighthouse_tpu.beacon_chain.harness import BeaconChainHarness
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    bls.set_backend("fake_crypto")
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    S = E.SLOTS_PER_EPOCH
+    epochs = 6 if SMOKE else 10
+
+    def run(migrate):
+        h = BeaconChainHarness(spec, E, validator_count=16)
+        h.chain.migrator.enabled = migrate
+        sizes = []
+        for _ep in range(epochs):
+            h.extend_chain(S)
+            sizes.append(
+                h.chain.store.column_stats()["hot"]["total_bytes"]
+            )
+        return h, sizes
+
+    h_on, on_sizes = run(True)
+    _partial(phase="migration_on", hot_bytes=on_sizes[-1])
+    h_off, off_sizes = run(False)
+    _partial(phase="migration_off", hot_bytes=off_sizes[-1])
+    # slope over the post-finality tail only: the first ~3 epochs are
+    # pre-finality on both sides and would dilute the contrast
+    tail = max(2, epochs // 2)
+
+    def slope(sizes):
+        return (sizes[-1] - sizes[-tail]) / (tail - 1)
+
+    slope_on, slope_off = slope(on_sizes), slope(off_sizes)
+    # headline is the FINAL hot-store size (positive and stable — a
+    # post-finality slope can legitimately go negative, which breaks
+    # --compare's relative-regression fraction); slopes ride as details
+    return {
+        "metric": "store_soak",
+        "value": on_sizes[-1],
+        "unit": f"hot-store bytes after {epochs} epochs, migration ON",
+        "config": {
+            "epochs": epochs,
+            "tail_epochs": tail,
+            "validators": 16,
+            "finalized_epoch_on": h_on.finalized_epoch,
+            "finalized_epoch_off": h_off.finalized_epoch,
+            "split_slot_on": h_on.chain.store.split_slot,
+            "spec": "minimal",
+        },
+        "sub_metrics": [
+            {
+                "metric": "store_soak_migration_off",
+                "value": off_sizes[-1],
+                "unit": (
+                    f"hot-store bytes after {epochs} epochs, migration "
+                    "OFF (control)"
+                ),
+            }
+        ],
+        "slopes_bytes_per_epoch": {
+            "on_tail": round(slope_on, 1),
+            "off_tail": round(slope_off, 1),
+        },
+        "hot_bytes_per_epoch": {"on": on_sizes, "off": off_sizes},
+        "growth_ratio_off_over_on": round(
+            off_sizes[-1] / max(on_sizes[-1], 1), 2
+        ),
+    }
+
+
+def bench_testnet_churn_soak(jax):
+    """Fleet churn soak (the kill/restart regime): every round one node
+    of a disk-backed fleet dies with its KV store kept, the fleet runs
+    an epoch without it, and it restarts from disk and catches back up —
+    the scenario oracle asserts finality never stalls, heads reconverge,
+    and the migrator keeps every hot store bounded. Headline: slots
+    finalized per wall-second across the whole churn (boot + kill +
+    restart + reconvergence included)."""
+    from dataclasses import replace
+
+    from lighthouse_tpu.metrics import REGISTRY
+    from lighthouse_tpu.testing.testnet import run_churn_soak_scenario
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.eth_spec import MinimalEthSpec as E
+
+    spec = replace(minimal_spec(), altair_fork_epoch=0)
+    node_count = 3 if SMOKE else 5
+    rounds = 1 if SMOKE else 3
+    report = run_churn_soak_scenario(
+        spec, E, node_count=node_count, churn_rounds=rounds, seed=2027
+    )
+    return {
+        "metric": "testnet_churn_soak",
+        "value": report["finalized_slots_per_wall_s"],
+        "unit": (
+            f"slots finalized per wall-second ({node_count}-node "
+            "disk-backed fleet, kill/restart churn)"
+        ),
+        "config": {
+            "nodes": node_count,
+            "churn_rounds": rounds,
+            "seed": report["seed"],
+            "spec": "minimal",
+        },
+        "sub_metrics": [
+            {
+                "metric": "testnet_churn_hot_growth",
+                "value": report["hot_store_growth"],
+                "unit": "x hot-store growth over churn (migrator bound)",
+            }
+        ],
+        "wall_s": report["wall_s"],
+        "finalized_epoch_min": report["finalized_epoch_min"],
+        "hot_store_bytes": report["hot_store_bytes"],
+        "counters": {
+            "kills": REGISTRY.counter(
+                "testnet_fault_injections_total"
+            ).value(kind="kill"),
+            "restarts": REGISTRY.counter(
+                "testnet_fault_injections_total"
+            ).value(kind="restart"),
+            "migrations": REGISTRY.counter(
+                "store_migrations_total"
+            ).value(),
+        },
+    }
+
+
 def bench_fork_choice(jax):
     """Array-program fork choice under a 1M-validator attestation flood:
     per trial, EVERY validator's latest-message vote moves (strictly-newer
@@ -3163,6 +3392,9 @@ _METRICS = {
     "api_throughput": bench_api_throughput,
     "sse_fanout": bench_sse_fanout,
     "vc_epoch_100k": bench_vc_epoch_100k,
+    "checkpoint_boot_s": bench_checkpoint_boot,
+    "store_soak": bench_store_soak,
+    "testnet_churn_soak": bench_testnet_churn_soak,
 }
 
 
@@ -3356,6 +3588,15 @@ def main():
         # + the 1/64 per-key-oracle control (generic pt_mul dominates);
         # BENCH_TIMEOUT_VC_EPOCH_100K overrides (0 = explicit skip)
         "vc_epoch_100k": 600,
+        # 8-epoch source chain + 3 checkpoint boots (3 HTTP round-trips
+        # each) + a full backfill per trial; fake_crypto, no compiles
+        "checkpoint_boot_s": 180,
+        # two 10-epoch harness chains (migration ON + OFF control),
+        # hot-store byte sampling per epoch; fake_crypto, no compiles
+        "store_soak": 240,
+        # disk-backed fleet boot + finality warmup + kill/restart rounds
+        # with reconvergence waits; fake_crypto, no compiles
+        "testnet_churn_soak": 420,
     }
     for name, cap in secondary_caps.items():
         cap = _metric_cap(name, cap)
@@ -3419,6 +3660,21 @@ def _rel_spread(entry: dict) -> float:
         return 0.0
 
 
+# Explicit per-metric regression directions, consulted BEFORE the unit
+# heuristic below. Slope/size metrics need this: store_soak's unit is
+# "bytes/epoch" — the "/s"-style probes can't classify it, and a growth
+# slope regresses UP no matter how its unit reads. True = higher is
+# better, False = lower is better; metrics not listed fall back to the
+# unit heuristic.
+_METRIC_DIRECTIONS = {
+    "checkpoint_boot_s": False,  # boot latency
+    "store_soak": False,  # final hot-store bytes, migration ON
+    "store_soak_migration_off": False,  # control (migration OFF)
+    "testnet_churn_soak": True,  # finalization throughput under churn
+    "testnet_churn_hot_growth": False,  # bounded-store multiple
+}
+
+
 def _higher_is_better(unit: str) -> bool:
     # throughputs count up: "leaves/sec", "cells/s (…)", and testnet_soak's
     # "slots finalized per wall-second" — the padded "/s " probe matches a
@@ -3459,7 +3715,12 @@ def compare_runs(old_path: str, new_path: str, threshold: float = 0.15) -> int:
         if ov == 0:
             rows.append((m, ov, nv, "n/a", "n/a", "SKIP (old=0)"))
             continue
-        higher = _higher_is_better(n.get("unit") or o.get("unit") or "")
+        direction = _METRIC_DIRECTIONS.get(m)
+        higher = (
+            direction
+            if direction is not None
+            else _higher_is_better(n.get("unit") or o.get("unit") or "")
+        )
         # regression fraction, positive = worse in this metric's direction
         r = (ov - nv) / ov if higher else (nv - ov) / ov
         tol = max(threshold, (_rel_spread(o) + _rel_spread(n)) / 2.0)

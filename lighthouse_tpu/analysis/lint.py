@@ -121,6 +121,18 @@ _VC_U64_PRODUCER_CALLS = {
     "compute_start_slot_at_epoch",
 }
 
+# store/-scoped additions (PR 20): the migration cycle's slot math —
+# finalized-boundary slots and the DA availability cutoff — are uint64
+# consensus quantities; a raw subtraction there underflows exactly where
+# the reference uses saturating_sub. Attrs stay empty: `.slot` is too
+# generic even inside store/ (the migrator's epoch-claim bookkeeping is
+# plain Python ints by design), so only the producer calls taint.
+_STORE_U64_ATTRS: set[str] = set()
+_STORE_U64_PRODUCER_CALLS = {
+    "compute_start_slot_at_epoch",
+    "da_window_slots",
+}
+
 # -- cow-aliasing vocabulary -------------------------------------------------
 
 _VIEW_PRODUCER_CALLS = {"load_array", "committee_array"}
@@ -416,8 +428,12 @@ def _check_safe_arith(tree: ast.Module, path: str) -> list[Violation]:
     # quantities the epoch sweeps mutate.
     # validator_client/ joined with the batched duty pipeline (PR 19),
     # with its own epoch/slot vocabulary (see _VC_U64_ATTRS).
+    # store/ joined with the lifecycle subsystem (PR 20): the migrator's
+    # finalized-slot / DA-cutoff arithmetic is uint64 slot math (see
+    # _STORE_U64_PRODUCER_CALLS).
     das_scoped = "lighthouse_tpu/das" in p
     vc_scoped = "lighthouse_tpu/validator_client" in p
+    store_scoped = "lighthouse_tpu/store" in p
     if (
         "state_processing" not in p
         and "fork_choice" not in p
@@ -425,6 +441,7 @@ def _check_safe_arith(tree: ast.Module, path: str) -> list[Violation]:
         and "state_advance" not in p
         and not das_scoped
         and not vc_scoped
+        and not store_scoped
     ):
         return []
     extra_attrs = frozenset()
@@ -435,6 +452,9 @@ def _check_safe_arith(tree: ast.Module, path: str) -> list[Violation]:
     if vc_scoped:
         extra_attrs |= frozenset(_VC_U64_ATTRS)
         extra_producers |= frozenset(_VC_U64_PRODUCER_CALLS)
+    if store_scoped:
+        extra_attrs |= frozenset(_STORE_U64_ATTRS)
+        extra_producers |= frozenset(_STORE_U64_PRODUCER_CALLS)
 
     def is_source(node, tainted):
         return _is_u64_source(node, tainted, extra_attrs, extra_producers)

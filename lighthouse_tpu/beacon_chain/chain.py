@@ -29,6 +29,7 @@ from ..state_processing.accessors import (
     get_current_epoch,
 )
 from ..store import HotColdDB
+from ..store.migrator import BackgroundMigrator
 from ..types.chain_spec import ChainSpec
 from ..utils.slot_clock import SlotClock
 from ..utils.tracing import span
@@ -169,7 +170,21 @@ class BeaconChain:
         # tick-path checkpoint promotion can always materialize the justified
         # state instead of keeping stale weights.
         self.fork_choice.state_provider = self._justified_state_provider
-        store.put_state(genesis_state.hash_tree_root(), genesis_state)
+        genesis_state_root = genesis_state.hash_tree_root()
+        # the anchor block is synthetic for genesis boots (never stored),
+        # so replay's base search needs this root→state mapping pinned
+        self.genesis_state_root = bytes(genesis_state_root)
+        store.put_state(genesis_state_root, genesis_state)
+        # restart anchor: boot stamps the (genesis or checkpoint) anchor;
+        # every migration cycle re-points it at the newest finalized
+        store.set_anchor_info(
+            int(genesis_state.slot), genesis_root, genesis_state_root
+        )
+        # finality-driven store lifecycle (store/migrator.py): hot→cold
+        # migration, fork pruning, restore-point snapshots, DA retention.
+        # Attaches itself as self.migrator; ClientBuilder wires its
+        # beacon_processor lane.
+        BackgroundMigrator(self)
 
     @classmethod
     def from_checkpoint(
@@ -206,6 +221,80 @@ class BeaconChain:
         )
         chain._blocks_by_root[anchor_root] = anchor_block
         store.put_block(anchor_root, anchor_block)
+        return chain
+
+    @classmethod
+    def from_store(
+        cls,
+        store: HotColdDB,
+        spec: ChainSpec,
+        E,
+        slot_clock: SlotClock,
+        **kwargs,
+    ) -> "BeaconChain":
+        """Restart from a persistent KV store (the kill→restart verb):
+        re-anchor on the persisted watermark's finalized block+state, then
+        re-import the surviving hot blocks oldest-first — signatures
+        skipped, they were verified at first import — to rebuild fork
+        choice and the snapshot cache. Range-sync/backfill watermarks live
+        in the same store, so sync resumes where it stopped instead of
+        re-downloading."""
+        from ..types.containers import build_types
+
+        if store.types is None:
+            store.types = build_types(E)
+        info = store.get_anchor_info()
+        if info is None:
+            raise BeaconChainError(
+                "store has no anchor watermark — not a restartable layout"
+            )
+        anchor_slot, block_root, state_root = info
+        anchor_block = store.get_block(block_root)
+        anchor_state = store.get_state(state_root)
+        if anchor_state is None:
+            raise BeaconChainError(
+                f"anchor {block_root.hex()[:8]} (slot {anchor_slot}) not "
+                "retrievable from store"
+            )
+        if anchor_block is None:
+            # a node killed before its first finality still restarts: the
+            # genesis anchor's block is synthetic (derived from the state,
+            # never stored), so boot the genesis way instead
+            if anchor_slot != 0:
+                raise BeaconChainError(
+                    f"anchor block {block_root.hex()[:8]} (slot "
+                    f"{anchor_slot}) not retrievable from store"
+                )
+            chain = cls(
+                store=store,
+                genesis_state=anchor_state,
+                spec=spec,
+                E=E,
+                slot_clock=slot_clock,
+                **kwargs,
+            )
+            anchor_block_slot = 0
+        else:
+            chain = cls.from_checkpoint(
+                store, anchor_state, anchor_block, spec, E, slot_clock,
+                **kwargs,
+            )
+            anchor_block_slot = int(anchor_block.message.slot)
+        # parents must enter fork choice before children; parent-unknown
+        # failures are tolerated (hot leftovers of forks whose ancestors
+        # already migrated or were pruned)
+        pending = [
+            (root, blk)
+            for root, blk in store.hot_blocks()
+            if blk.message.slot > anchor_block_slot
+        ]
+        pending.sort(key=lambda e: int(e[1].message.slot))
+        skip = {root for root, _ in pending}
+        for _root, blk in pending:
+            try:
+                chain.process_block(blk, segment_verified_roots=skip)
+            except (BlockError, BlobsUnavailableError):
+                continue
         return chain
 
     @property
@@ -331,7 +420,12 @@ class BeaconChain:
             return None
         state = self.store.get_state(signed.message.state_root)
         if state is None:
-            state = self._replay_state(block_root)
+            if signed.message.slot < self.store.split_slot:
+                # pre-split: restore-point snapshot + replay, memoized in
+                # the migrator's bounded LRU (store/src/reconstruct.rs)
+                state = self.migrator.reconstruct_state(block_root)
+            else:
+                state = self._replay_state(block_root)
         if state is not None:
             # SSZ deserialization yields plain lists — restore the
             # tree-states persistence for the lineage built from here
@@ -362,6 +456,15 @@ class BeaconChain:
                 break
             signed = self._signed_block(r)
             if signed is None:
+                # the anchor/genesis block is synthetic — no stored block
+                # maps its root to a state root, but the boot pinned the
+                # state itself (migration keeps a cold copy: slot 0 is
+                # always a restore point)
+                if r == self.genesis_block_root:
+                    st = self.store.get_state(self.genesis_state_root)
+                    if st is not None:
+                        base = st.copy()
+                        break
                 return None
             st = self.store.get_state(signed.message.state_root)
             if st is not None:
@@ -665,7 +768,10 @@ class BeaconChain:
         self.op_pool.prune(self.head_state)
         if commitments:
             self.data_availability_checker.pop(block_root)
-        self._prune_at_finality()
+        # finality advance → migration cycle: queued on the MIGRATE_STORE
+        # lane when a processor is wired, else inline under the import
+        # write lock this path already holds
+        self.migrator.on_finality()
         return block_root
 
     def process_chain_segment(self, blocks) -> ChainSegmentResult:
@@ -757,84 +863,9 @@ class BeaconChain:
             raise BlockError("segment bulk signature verification failed")
         return roots, post_states
 
-    def _prune_at_finality(self):
-        """Drop snapshot-cache states that can no longer become head, and
-        migrate finalized blocks to the cold DB (migrate.rs)."""
-        finalized = self.finalized_checkpoint
-        if finalized.epoch == 0:
-            return
-        finalized_slot = compute_start_slot_at_epoch(finalized.epoch, self.E)
-        self.data_availability_checker.prune_before(finalized_slot)
-        self.block_times_cache.prune(finalized_slot)
-        droppable = [
-            root
-            for root, st in self._states.items()
-            if st.slot < finalized_slot and root != self.head_root
-            and root != finalized.root
-        ]
-        # Canonical finalized ancestors, walked via block parent links (the
-        # proto array may already have pruned these nodes, so it cannot be
-        # asked).
-        canonical: set[bytes] = set()
-        r = finalized.root
-        while True:
-            blk = self._blocks_by_root.get(r)
-            if blk is None:
-                break
-            parent = blk.message.parent_root
-            if parent in canonical or parent == r:
-                break
-            canonical.add(parent)
-            r = parent
-
-        migrated = []
-        for root in droppable:
-            st = self._states.pop(root, None)
-            if st is not None:
-                # hot DB keeps only unfinalized states (hot_cold_store split);
-                # the block already carries the state root — no re-hash.
-                blk = self._blocks_by_root.get(root)
-                state_root = (
-                    blk.message.state_root if blk is not None else st.hash_tree_root()
-                )
-                self.store.delete_state(state_root)
-            if root in canonical:
-                # canonical ancestor of the finalized checkpoint → cold DB
-                migrated.append(root)
-            else:
-                # pruned fork: drop entirely (incl. any staged sidecars)
-                self._blocks_by_root.pop(root, None)
-                self.store.delete_blob_sidecars(root)
-                self.store.delete_data_column_sidecars(root)
-        if migrated:
-            self.store.migrate_to_cold(finalized_slot, migrated)
-        # DA retention: drop sidecars/columns of canonical blocks aged out
-        # of the window (deneb p2p MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS).
-        # The slot-keyed store index walks ONLY the expired slots — the
-        # former full blob_sidecar_entries() scan re-read every key's slot
-        # prefix on every prune cycle (ISSUE 16 satellite); orphaned fork
-        # entries are deleted eagerly in the fork-drop loop above.
-        da_cutoff = finalized_slot - self.da_window_slots()
-        for root, _sc_slot in self.store.blob_sidecar_entries_before(da_cutoff):
-            self.store.delete_blob_sidecars(root)
-        for root, _sc_slot in self.store.data_column_entries_before(da_cutoff):
-            self.store.delete_data_column_sidecars(root)
-        # orphan backstop: entries whose block never imported (staged for a
-        # fork that lost). The entry walk is the in-memory index — the DB is
-        # only consulted for roots already absent from the block map.
-        for root, _sc_slot in self.store.blob_sidecar_entries():
-            if root not in self._blocks_by_root and not self.store.block_exists(
-                root
-            ):
-                self.store.delete_blob_sidecars(root)
-        for root, _sc_slot in self.store.data_column_entries():
-            if root not in self._blocks_by_root and not self.store.block_exists(
-                root
-            ):
-                self.store.delete_data_column_sidecars(root)
-        self.observed_attesters.prune(finalized.epoch)
-        self.observed_aggregators.prune(finalized.epoch)
-        self.observed_block_producers.prune(finalized_slot)  # keyed by slot
+    # finality pruning/migration moved to store/migrator.py
+    # (BackgroundMigrator._migrate_cycle), extended with restore-point
+    # snapshots and availability-window accounting
 
     # ------------------------------------------------------------------ gossip attestations
 

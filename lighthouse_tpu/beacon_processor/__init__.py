@@ -68,6 +68,12 @@ class WorkType(enum.IntEnum):
     #: every protocol lane before detection takes a worker, and detection
     #: NEVER runs inline on a gossip reader thread (queue-discipline)
     SLASHER_PROCESS = 16
+    #: finality migration + store pruning (store/migrator): hot→cold
+    #: block moves, restore-point snapshots, DA-window pruning. Like the
+    #: slasher it is pure background hygiene — nothing protocol-critical
+    #: waits on it, so it drains dead last (migrate.rs's dedicated
+    #: migrator thread maps to the lowest lane here)
+    MIGRATE_STORE = 17
 
 
 _QUEUE_BOUNDS = {
@@ -93,6 +99,9 @@ _QUEUE_BOUNDS = {
     # one epoch tick per slot; a tiny bound surfaces a stalled worker
     # pool as drop-counted backpressure instead of a silent backlog
     WorkType.SLASHER_PROCESS: 4,
+    # one migration per finalized epoch; the per-epoch claim already
+    # deduplicates, the bound only backstops a stalled pool
+    WorkType.MIGRATE_STORE: 2,
 }
 
 _BATCHED = {

@@ -87,6 +87,7 @@ def cmd_beacon_node(args):
         validate=args.validate and checkpoint_state is None,
         manual_slot_clock=False,
         genesis_state=checkpoint_state,
+        checkpoint_sync_url=args.checkpoint_sync_url,
         slasher=args.slasher,
         bls_backend=backend,
         kzg=args.kzg,
@@ -503,6 +504,11 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument(
         "--checkpoint-state", default=None,
         help="SSZ BeaconState file to boot from (checkpoint sync)",
+    )
+    bn.add_argument(
+        "--checkpoint-sync-url", default=None,
+        help="peer Beacon API URL to fetch+verify a finalized checkpoint "
+        "from (an already-populated --db-path resumes instead)",
     )
     bn.add_argument("--slasher", action="store_true")
     bn.add_argument(

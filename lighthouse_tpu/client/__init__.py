@@ -16,7 +16,7 @@ from ..beacon_chain.timer import SlotTimer
 from ..crypto import bls
 from ..metrics import set_gauge
 from ..state_processing import interop_genesis_state
-from ..store import HotColdDB, MemoryStore, open_item_store
+from ..store import HotColdDB, MemoryStore, open_hot_cold
 from ..utils.logging import get_logger
 from ..utils.slot_clock import ManualSlotClock, SystemTimeSlotClock
 from ..utils.task_executor import ShutdownSignal, TaskExecutor
@@ -40,6 +40,10 @@ class ClientConfig:
     mock_execution_layer: bool = True
     manual_slot_clock: bool = True  # tests drive slots by hand
     genesis_state: object = None  # checkpoint-sync style provided state
+    # boot from a peer's finalized checkpoint over its Beacon API
+    # (beacon_chain/checkpoint_sync.py). A populated db_path store wins:
+    # a restart resumes from its own anchor instead of re-fetching.
+    checkpoint_sync_url: str | None = None
     genesis_time: int = 1_600_000_000
     slasher: bool = False  # run the in-process slashing detector
     # BLS backend the node runs with (crypto/bls/src/lib.rs:84-139 seam):
@@ -165,13 +169,52 @@ class ClientBuilder:
             # device epoch sweep rides the same device the verifier uses;
             # an explicit env setting (incl. "0") wins
             os.environ.setdefault("LIGHTHOUSE_TPU_DEVICE_EPOCH_SWEEP", "1")
-        # store
+        # store: disk-backed nodes get a persistent cold side too (the
+        # single-store open left cold as a process-lifetime MemoryStore,
+        # so migrated history evaporated on restart)
+        resume_anchor = None
         if cfg.db_path:
-            store = HotColdDB(open_item_store(cfg.db_path, cfg.db_backend))
+            store = open_hot_cold(cfg.db_path, cfg.db_backend)
+            resume_anchor = store.get_anchor_info()
         else:
             store = HotColdDB(MemoryStore())
-        # genesis
-        if cfg.genesis_state is not None:
+        # genesis source, in priority order: an already-populated store
+        # (restart), a peer checkpoint URL (join), a provided state, or
+        # interop keys. Restart/join anchor states carry the network's
+        # genesis_time, which is what the slot clock must run on.
+        checkpoint = None
+        genesis_state = None
+        if resume_anchor is not None:
+            from ..types.containers import build_types
+
+            store.types = build_types(cfg.E)
+            anchor_state = store.get_state(resume_anchor[2])
+            if anchor_state is None:
+                raise ValueError(
+                    f"store at {cfg.db_path} has an anchor watermark but "
+                    "no retrievable anchor state"
+                )
+            clock_genesis_time = anchor_state.genesis_time
+            c.keypairs = (
+                list(cfg.keypairs)
+                if cfg.keypairs is not None
+                else bls.interop_keypairs(cfg.validator_count)
+            )
+        elif cfg.checkpoint_sync_url:
+            from ..beacon_chain.checkpoint_sync import (
+                fetch_finalized_checkpoint,
+            )
+
+            checkpoint = fetch_finalized_checkpoint(
+                cfg.checkpoint_sync_url, cfg.E
+            )
+            clock_genesis_time = checkpoint.state.genesis_time
+            c.keypairs = (
+                list(cfg.keypairs)
+                if cfg.keypairs is not None
+                else bls.interop_keypairs(cfg.validator_count)
+            )
+        elif cfg.genesis_state is not None:
             # provided (checkpoint-style) state: interop keys would not
             # match its registry — signers must be wired explicitly
             if cfg.validate:
@@ -180,6 +223,7 @@ class ClientBuilder:
                     "ValidatorClient with that network's keys instead"
                 )
             genesis_state = cfg.genesis_state
+            clock_genesis_time = genesis_state.genesis_time
         else:
             c.keypairs = (
                 list(cfg.keypairs)
@@ -189,15 +233,16 @@ class ClientBuilder:
             genesis_state = interop_genesis_state(
                 c.keypairs, cfg.genesis_time, b"\x42" * 32, cfg.spec, cfg.E
             )
+            clock_genesis_time = genesis_state.genesis_time
         # clocks
         if cfg.manual_slot_clock:
             c.slot_clock = ManualSlotClock(
-                genesis_time=genesis_state.genesis_time,
+                genesis_time=clock_genesis_time,
                 seconds_per_slot=cfg.spec.seconds_per_slot,
             )
         else:
             c.slot_clock = SystemTimeSlotClock(
-                genesis_time=genesis_state.genesis_time,
+                genesis_time=clock_genesis_time,
                 seconds_per_slot=cfg.spec.seconds_per_slot,
             )
         # execution layer
@@ -221,16 +266,47 @@ class ClientBuilder:
                 else TrustedSetup.default()
             )
             kzg = Kzg(setup, device=(cfg.bls_backend == "tpu") or None)
-        # chain
-        c.chain = BeaconChain(
-            store=store,
-            genesis_state=genesis_state,
-            spec=cfg.spec,
-            E=cfg.E,
-            slot_clock=c.slot_clock,
-            execution_layer=execution_layer,
-            kzg=kzg,
-        )
+        # chain: restart resumes from the store's anchor watermark +
+        # surviving hot blocks; join anchors on the verified peer
+        # checkpoint; otherwise a fresh genesis boot
+        if resume_anchor is not None:
+            c.chain = BeaconChain.from_store(
+                store,
+                cfg.spec,
+                cfg.E,
+                c.slot_clock,
+                execution_layer=execution_layer,
+                kzg=kzg,
+            )
+        elif checkpoint is not None:
+            c.chain = BeaconChain.from_checkpoint(
+                store,
+                checkpoint.state,
+                checkpoint.block,
+                cfg.spec,
+                cfg.E,
+                c.slot_clock,
+                wss_checkpoint=checkpoint.block_root,
+                execution_layer=execution_layer,
+                kzg=kzg,
+            )
+            from ..metrics import inc_counter
+
+            inc_counter("checkpoint_sync_boots_total")
+            set_gauge(
+                "checkpoint_sync_anchor_slot",
+                int(checkpoint.block.message.slot),
+            )
+        else:
+            c.chain = BeaconChain(
+                store=store,
+                genesis_state=genesis_state,
+                spec=cfg.spec,
+                E=cfg.E,
+                slot_clock=c.slot_clock,
+                execution_layer=execution_layer,
+                kzg=kzg,
+            )
         # network
         if cfg.network_port is not None:
             from ..network import NetworkService
@@ -253,6 +329,9 @@ class ClientBuilder:
                 sync_service_interval=cfg.sync_service_interval,
                 **cfg.network_kwargs,
             )
+            # migration cycles ride the network's MIGRATE_STORE lane
+            # (lowest priority) instead of running inline on import paths
+            c.chain.migrator.processor = c.network.processor
         # http (identity/peers routes read the network when present)
         if cfg.http_port is not None:
             from ..http_api import HttpApiServer

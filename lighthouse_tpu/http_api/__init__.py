@@ -1360,6 +1360,18 @@ class BeaconApi:
             ]
         }
 
+    def _blob_sidecars_consistent(self, root: bytes) -> list:
+        """Sidecar read with a store-generation guard: an empty result
+        observed while a migration/prune batch was running underneath is
+        re-read against the settled view, so a block that legitimately
+        has sidecars never serves [] mid-batch."""
+        store = self.chain.store
+        gen = store.generation
+        sidecars = store.get_blob_sidecars(root)
+        if not sidecars and store.generation != gen:
+            sidecars = store.get_blob_sidecars(root)
+        return sidecars
+
     def blob_sidecars(self, block_id: str):
         """GET /eth/v1/beacon/blob_sidecars/{block_id} — JSON shape."""
         root, _signed = self._block(block_id)
@@ -1371,14 +1383,14 @@ class BeaconApi:
                     "kzg_commitment": _hex(sc.kzg_commitment),
                     "kzg_proof": _hex(sc.kzg_proof),
                 }
-                for sc in self.chain.store.get_blob_sidecars(root)
+                for sc in self._blob_sidecars_consistent(root)
             ]
         }
 
     def blob_sidecars_ssz(self, block_id: str) -> bytes:
         """Same route under Accept: application/octet-stream."""
         root, _signed = self._block(block_id)
-        sidecars = self.chain.store.get_blob_sidecars(root)
+        sidecars = self._blob_sidecars_consistent(root)
         t = self.chain.types
         from ..ssz.core import List as SszList
 

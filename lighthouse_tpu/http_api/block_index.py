@@ -41,8 +41,25 @@ class BlockHeaderIndex:
         by an equal number of imports (same dict length) is still
         caught."""
         blocks = self._chain._blocks_by_root
+        store = getattr(self._chain, "store", None)
         with self._lock:
-            keys = set(blocks)
+            # prune-while-serving: a migration batch pops hot roots while
+            # we snapshot the key set. Retry on a torn iteration OR when
+            # the store generation moved mid-snapshot — the settled view
+            # is one bounded retry away (batches are finite and the
+            # import lock serializes them).
+            keys = None
+            for _attempt in range(3):
+                gen = store.generation if store is not None else None
+                try:
+                    keys = set(blocks)
+                except RuntimeError:  # dict mutated during iteration
+                    keys = None
+                    continue
+                if store is None or store.generation == gen:
+                    break
+            if keys is None:
+                return  # batch still churning; next request resyncs
             if keys == self._hot:
                 return
             for root in self._hot - keys:
@@ -128,7 +145,13 @@ class BlockHeaderIndex:
         store = getattr(self._chain, "store", None)
         if store is None:
             return None
+        gen = store.generation
         b = store.get_block(root)
+        if b is None and store.generation != gen:
+            # a migration batch ran underneath the lookup (hot map miss →
+            # store miss can tear across the hot-delete/cold-put handoff);
+            # one retry reads the settled view
+            b = store.get_block(root)
         if b is None:
             return None
         with self._lock:

@@ -166,6 +166,15 @@ def chain_health(chain) -> dict:
     }
 
 
+def store_health(chain) -> dict:
+    """The `store` block of /lighthouse/health: per-side (hot/cold),
+    per-column key/byte counts plus the split and anchor watermarks,
+    straight off the node's own HotColdDB. The churn-soak oracle asserts
+    bounded hot-store size from these numbers — with the migrator off the
+    hot side grows linearly, with it on the slope flattens at finality."""
+    return chain.store.column_stats()
+
+
 def _participation_rate(chain, state) -> float | None:
     """Fraction of previous-epoch active (unslashed) validators whose
     participation flags carry TIMELY_TARGET — the liveness number the
@@ -220,6 +229,7 @@ def process_health(chain=None) -> dict:
     busy = REGISTRY.gauge("beacon_processor_workers_busy").value()
     return {
         **({"chain": chain_health(chain)} if chain is not None else {}),
+        **({"store": store_health(chain)} if chain is not None else {}),
         "uptime_seconds": round(time.monotonic() - PROCESS_START_MONOTONIC, 3),
         "started_at_unix": int(PROCESS_START_EPOCH),
         "rss_bytes": _proc_self_status_kb("VmRSS") * 1024,

@@ -49,6 +49,28 @@ def open_item_store(path: str, backend: str = "auto") -> ItemStore:
     return SqliteStore(path)
 
 
+def cold_path_for(path: str) -> str:
+    """On-disk location of the cold store paired with a hot store at
+    `path` (same engine, sibling layout)."""
+    return path.rstrip("/") + ".cold"
+
+
+def open_hot_cold(path: str, backend: str = "auto", types=None) -> HotColdDB:
+    """Open a fully persistent HotColdDB at `path`: hot store at the path
+    itself, cold store at `cold_path_for(path)`. The former single-store
+    open left `cold` as a process-lifetime MemoryStore, so migrated
+    history silently evaporated on restart."""
+    hot = open_item_store(path, backend)
+    # pin the cold side to the engine the hot side resolved to — "auto"
+    # on a fresh cold path must not pick a different backend
+    cold_backend = backend
+    if backend == "auto":
+        cold_backend = "sqlite" if isinstance(hot, SqliteStore) else "native"
+    return HotColdDB(
+        hot, cold=open_item_store(cold_path_for(path), cold_backend), types=types
+    )
+
+
 __all__ = [
     "DBColumn",
     "ItemStore",
@@ -56,4 +78,6 @@ __all__ = [
     "SqliteStore",
     "HotColdDB",
     "open_item_store",
+    "open_hot_cold",
+    "cold_path_for",
 ]

@@ -3,10 +3,10 @@
 Mirrors beacon_node/store/src/hot_cold_store.rs:50-55: hot (recent,
 unfinalized) data separate from cold (finalized history), split at the
 finalization boundary; states in the hot DB carry summaries, cold states are
-reconstructable from restore points. This round implements the hot side +
-split bookkeeping + migration of finalized blocks to cold; cold-state
-restore-point reconstruction (store/src/reconstruct.rs) comes with the
-database manager."""
+reconstructable from restore points. The hot side + split bookkeeping live
+here; the finality-driven migration cycle, periodic cold restore-point
+snapshots, and snapshot+replay reconstruction of intermediate cold states
+(store/src/reconstruct.rs) live in `store/migrator.py`."""
 
 from __future__ import annotations
 
@@ -18,6 +18,11 @@ HEAD_KEY = b"head"
 GENESIS_KEY = b"genesis"
 FORK_CHOICE_KEY = b"fork_choice"
 SCHEMA_VERSION_KEY = b"schema"
+# anchor watermark: slot (8B LE) || anchor block root (32B) || anchor state
+# root (32B) — written at boot (genesis or checkpoint) and re-pointed at the
+# finalized checkpoint by every migration cycle, so a restart can re-anchor
+# on the newest finalized state instead of replaying from genesis
+ANCHOR_INFO_KEY = b"anchor_info"
 
 # On-disk schema version (store/src/lib.rs CURRENT_SCHEMA_VERSION analog).
 # Bump on any layout change; `open` detects mismatches so a migration (or a
@@ -58,6 +63,10 @@ class HotColdDB:
         # maintained by every put/delete after that — pruning walks only
         # expired slots instead of rescanning every entry (ISSUE 16)
         self._da_index: dict = {}
+        # store generation: bumped after every migration/prune batch so
+        # concurrent readers (API tier indexes, sidecar serving) can detect
+        # that a batch ran mid-read and retry against a settled view
+        self._generation = 0
         self._check_schema_version()
 
     def _check_schema_version(self):
@@ -115,6 +124,17 @@ class HotColdDB:
         if data is None:
             return None
         return self._decode(data, "SignedBeaconBlock")
+
+    def hot_blocks(self) -> list:
+        """Decode every hot (unfinalized) block as (root, signed_block) —
+        the restart path re-imports these to rebuild fork choice above the
+        persisted anchor."""
+        out = []
+        for root in self.hot.keys(DBColumn.BEACON_BLOCK):
+            data = self.hot.get(DBColumn.BEACON_BLOCK, root)
+            if data is not None:
+                out.append((root, self._decode(data, "SignedBeaconBlock")))
+        return out
 
     def delete_block(self, block_root: bytes):
         """Hot-only deletion (fork_revert wipes unfinalized segments;
@@ -267,8 +287,19 @@ class HotColdDB:
     # -- states ------------------------------------------------------------
 
     def put_state(self, state_root: bytes, state):
+        """Unfinalized state → hot DB (the split invariant: hot holds
+        recent states, cold holds restore-point snapshots only)."""
         fork = self.types.fork_of_state(state)
         self.hot.put(
+            DBColumn.BEACON_STATE, state_root, self._encode(state, fork)
+        )
+
+    def put_cold_state(self, state_root: bytes, state):
+        """Finalized restore-point snapshot → cold DB explicitly. The old
+        hot-only `put_state` left `get_state`'s cold fallback permanently
+        dead for anything the migrator wrote (ISSUE 20 satellite)."""
+        fork = self.types.fork_of_state(state)
+        self.cold.put(
             DBColumn.BEACON_STATE, state_root, self._encode(state, fork)
         )
 
@@ -280,8 +311,18 @@ class HotColdDB:
             return None
         return self._decode(data, "BeaconState")
 
-    def delete_state(self, state_root: bytes):
-        self.hot.delete(DBColumn.BEACON_STATE, state_root)
+    def delete_state(self, state_root: bytes, side: str = "both"):
+        """Side-aware deletion. Default removes BOTH copies — a state
+        migrated to cold and then deleted must not resurrect through
+        `get_state`'s cold fallback. The migrator passes side="hot" when
+        it intentionally keeps (or just wrote) a cold snapshot of the
+        same root."""
+        if side not in ("both", "hot", "cold"):
+            raise StoreError(f"unknown state deletion side {side!r}")
+        if side in ("both", "hot"):
+            self.hot.delete(DBColumn.BEACON_STATE, state_root)
+        if side in ("both", "cold"):
+            self.cold.delete(DBColumn.BEACON_STATE, state_root)
 
     # -- metadata ----------------------------------------------------------
 
@@ -305,6 +346,55 @@ class HotColdDB:
     def get_fork_choice_snapshot(self) -> bytes | None:
         return self.hot.get(DBColumn.FORK_CHOICE, FORK_CHOICE_KEY)
 
+    def set_anchor_info(self, slot: int, block_root: bytes, state_root: bytes):
+        """Persist the restart anchor: the newest finalized (slot, block
+        root, state root) whose state is retrievable from this store."""
+        self.put_meta(
+            ANCHOR_INFO_KEY,
+            int(slot).to_bytes(8, "little") + bytes(block_root) + bytes(state_root),
+        )
+
+    def get_anchor_info(self) -> tuple[int, bytes, bytes] | None:
+        raw = self.get_meta(ANCHOR_INFO_KEY)
+        if raw is None or len(raw) != 72:
+            return None
+        return int.from_bytes(raw[:8], "little"), raw[8:40], raw[40:72]
+
+    @property
+    def generation(self) -> int:
+        """Monotonic batch counter for prune-while-serving readers: a
+        reader that sees the generation move across its lookup knows a
+        migration batch ran underneath it and retries."""
+        return self._generation
+
+    def bump_generation(self):
+        self._generation += 1
+
+    def column_stats(self) -> dict:
+        """Per-side, per-column {keys, bytes} plus split/anchor watermarks
+        — the `store` block of `/lighthouse/health` (the oracle asserts
+        bounded hot-store size off these numbers). Only columns with at
+        least one key are listed, keeping the block small."""
+        out: dict = {"split_slot": self.split_slot}
+        anchor = self.get_anchor_info()
+        out["anchor_slot"] = anchor[0] if anchor else 0
+        for side_name, side in (("hot", self.hot), ("cold", self.cold)):
+            cols = {}
+            total_keys = 0
+            total_bytes = 0
+            for col in DBColumn:
+                count, size = side.stats(col)
+                if count:
+                    cols[col.name.lower()] = {"keys": count, "bytes": size}
+                    total_keys += count
+                    total_bytes += size
+            out[side_name] = {
+                "columns": cols,
+                "total_keys": total_keys,
+                "total_bytes": total_bytes,
+            }
+        return out
+
     # -- migration (beacon_chain/src/migrate.rs analog) ---------------------
 
     def migrate_to_cold(self, finalized_slot: int, finalized_block_roots):
@@ -323,3 +413,7 @@ class HotColdDB:
             ("put", DBColumn.BEACON_META, SPLIT_KEY, finalized_slot.to_bytes(8, "little"))
         )
         self.hot.do_atomically(ops_hot)
+        # cold puts land before hot deletes, so get_block never sees a
+        # window where a migrated block is on neither side; the bump lets
+        # index readers detect the hot→cold handoff mid-scan
+        self.bump_generation()

@@ -60,6 +60,19 @@ class ItemStore:
     def keys(self, column: DBColumn):
         raise NotImplementedError
 
+    def stats(self, column: DBColumn) -> tuple[int, int]:
+        """(key count, total value bytes) for one column — the
+        `/lighthouse/health` store block's raw material. Default walks
+        keys+values; backends with cheaper aggregates override."""
+        count = 0
+        total = 0
+        for key in self.keys(column):
+            v = self.get(column, key)
+            if v is not None:
+                count += 1
+                total += len(v)
+        return count, total
+
     def do_atomically(self, ops: list):
         """ops: list of ("put", col, key, value) | ("delete", col, key)."""
         for op in ops:
@@ -95,6 +108,15 @@ class MemoryStore(ItemStore):
     def keys(self, column):
         with self._lock:
             return [k for (c, k) in self._data if c == column.value]
+
+    def stats(self, column):
+        with self._lock:
+            sizes = [
+                len(v)
+                for (c, _k), v in self._data.items()
+                if c == column.value
+            ]
+        return len(sizes), sum(sizes)
 
     def do_atomically(self, ops):
         with self._lock:
@@ -148,6 +170,14 @@ class SqliteStore(ItemStore):
     def keys(self, column):
         cur = self._conn.execute(f"SELECT k FROM c_{column.value}")
         return [row[0] for row in cur.fetchall()]
+
+    def stats(self, column):
+        cur = self._conn.execute(
+            f"SELECT count(*), coalesce(sum(length(v)), 0) "
+            f"FROM c_{column.value}"
+        )
+        count, total = cur.fetchone()
+        return int(count), int(total)
 
     def get_prefix(self, column, key, n):
         # substr keeps multi-hundred-KiB blob values out of the page

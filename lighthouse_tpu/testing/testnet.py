@@ -81,6 +81,12 @@ FAULT_KINDS = (
     "flood",
     "equivocation",
     "withhold",
+    # storage-lifecycle verbs (disk-backed fleets): process death with
+    # the KV store kept, rebuild-from-store, checkpoint-boot into the
+    # live fleet
+    "kill",
+    "restart",
+    "join",
 )
 # eager registration: the scenario_smoke tier-1 run and dashboards read
 # these series before the first fault is ever injected
@@ -354,6 +360,10 @@ class TestnetNode:
     name: str
     client: Client
     is_attacker: bool = False
+    # kill() flips this and restart() flips it back; the node object (and
+    # its index in Testnet.nodes, which _mesh_edges refers to) is stable
+    # across the whole kill→restart cycle — only `client` is replaced
+    alive: bool = True
 
     @property
     def chain(self):
@@ -396,9 +406,15 @@ class Testnet:
     rng: random.Random
     kzg: str = "none"
     api_workers: int = 0  # forked API read replicas per full node (PR 18)
+    # disk-backed fleet root: node N's hot store lives at
+    # {db_dir}/{name}, its cold store beside it at {name}.cold — the
+    # prerequisite for the kill/restart/join lifecycle verbs
+    db_dir: str | None = None
+    db_backend: str = "sqlite"
     keypairs: list = field(default_factory=list)
     nodes: list[TestnetNode] = field(default_factory=list)
     attackers: list[TestnetNode] = field(default_factory=list)
+    _boot_kwargs: dict = field(default_factory=dict)
     _flood_stop: threading.Event = field(default_factory=threading.Event)
     _flood_threads: list = field(default_factory=list)
     flood_sent: int = 0
@@ -422,6 +438,8 @@ class Testnet:
         full_mesh_max: int = 12,
         kzg: str = "none",
         api_workers: int = 0,
+        db_dir: str | None = None,
+        db_backend: str = "sqlite",
     ) -> "Testnet":
         """Boot `node_count` full nodes (ClientBuilder each: chain +
         fault-planed network + Beacon API + VC over a disjoint key share)
@@ -435,6 +453,7 @@ class Testnet:
         net = cls(
             spec=spec, E=E, plane=plane, seed=seed, rng=rng, kzg=kzg,
             api_workers=api_workers, keypairs=keypairs,
+            db_dir=db_dir, db_backend=db_backend,
         )
         share = validator_count // node_count
         for i in range(node_count):
@@ -472,10 +491,48 @@ class Testnet:
         heartbeat_interval: float,
         sync_service_interval: float | None,
         attacker: bool = False,
+        checkpoint_sync_url: str | None = None,
     ) -> TestnetNode:
+        # remembered verbatim so restart() can rebuild the same node
+        # (only `client` changes — the TestnetNode and its mesh index
+        # stay stable)
+        self._boot_kwargs[name] = dict(
+            vc_keypairs=vc_keypairs,
+            slasher=slasher,
+            bls_backend=bls_backend,
+            heartbeat_interval=heartbeat_interval,
+            sync_service_interval=sync_service_interval,
+            attacker=attacker,
+        )
+        client = self._build_client(
+            name, checkpoint_sync_url=checkpoint_sync_url,
+            **self._boot_kwargs[name],
+        )
+        node = TestnetNode(name, client, is_attacker=attacker)
+        (self.attackers if attacker else self.nodes).append(node)
+        return node
+
+    def _build_client(
+        self,
+        name: str,
+        *,
+        vc_keypairs,
+        slasher: bool,
+        bls_backend: str,
+        heartbeat_interval: float,
+        sync_service_interval: float | None,
+        attacker: bool = False,
+        checkpoint_sync_url: str | None = None,
+    ) -> Client:
         cfg = ClientConfig(
             spec=self.spec,
             E=self.E,
+            db_path=(
+                os.path.join(self.db_dir, name)
+                if self.db_dir is not None
+                else None
+            ),
+            db_backend=self.db_backend,
             validator_count=len(self.keypairs),
             keypairs=self.keypairs,
             vc_keypairs=vc_keypairs,
@@ -491,6 +548,7 @@ class Testnet:
             manual_slot_clock=True,
             genesis_time=TESTNET_GENESIS_TIME,
             sync_service_interval=sync_service_interval,
+            checkpoint_sync_url=checkpoint_sync_url,
             network_cls=TestnetNetworkService,
             network_kwargs=dict(
                 plane=self.plane,
@@ -505,9 +563,7 @@ class Testnet:
             # two instead of the production 5 s status refresh
             client.network.sync_service.status_poll_interval = 1.0
         self.plane.register(name, "127.0.0.1", client.network.port)
-        node = TestnetNode(name, client, is_attacker=attacker)
-        (self.attackers if attacker else self.nodes).append(node)
-        return node
+        return client
 
     def _wire_mesh(self, full_mesh_max: int):
         fleet = self.nodes
@@ -541,9 +597,14 @@ class Testnet:
                 return n
         raise KeyError(name)
 
+    @property
+    def live_nodes(self) -> list[TestnetNode]:
+        return [n for n in self.nodes if n.alive]
+
     def set_slot(self, slot: int):
         for n in self.nodes + self.attackers:
-            n.client.slot_clock.set_slot(slot)
+            if n.alive:
+                n.client.slot_clock.set_slot(slot)
 
     def run_slot(self, slot: int, propose: bool = True):
         """One slot in protocol order across the fleet: tick every clock,
@@ -552,14 +613,14 @@ class Testnet:
         intra-slot schedule, event-driven instead of timed)."""
         self.set_slot(slot)
         if propose:
-            for n in self.nodes:
+            for n in self.live_nodes:
                 try:
                     n.vc.block_service.propose_if_due(slot)
                 except Exception as e:  # noqa: BLE001 — a partitioned/eclipsed
                     # proposer missing its duty is scenario-normal
                     log.info("proposal missed", node=n.name, error=str(e)[:120])
         self.settle()
-        for n in self.nodes:
+        for n in self.live_nodes:
             try:
                 head = n.chain.head_root
                 n.vc.attestation_service.attest(slot, head)
@@ -576,7 +637,7 @@ class Testnet:
         """Wait for gossip convergence WITHIN each fault-plane component:
         all fleet heads in a component equal (partitioned halves converge
         separately; an eclipsed victim is a singleton and never blocks)."""
-        comps = self.plane.components([n.name for n in self.nodes])
+        comps = self.plane.components([n.name for n in self.live_nodes])
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             done = True
@@ -618,6 +679,94 @@ class Testnet:
         self._flood_stop.set()
         self._reconnect_mesh()
         log.info("fault plane healed", seed=self.seed)
+
+    # -- storage lifecycle verbs (disk-backed fleets) ----------------------
+
+    def kill(self, name: str) -> TestnetNode:
+        """Hard-stop a node: every thread and socket goes away and the
+        store handles close, but the on-disk KV stores (hot + cold)
+        survive — the process-death half of the kill→restart cycle."""
+        if self.db_dir is None:
+            raise ScenarioFailure(
+                "kill/restart need a disk-backed fleet (Testnet.create "
+                "db_dir=...)"
+            )
+        node = self.node(name)
+        inc_counter("testnet_fault_injections_total", kind="kill")
+        node.client.stop()
+        try:
+            # a dead process holds no file handles; WAL contents persist
+            node.chain.store.hot.close()
+            node.chain.store.cold.close()
+        except Exception as e:  # noqa: BLE001 — already-closed is fine
+            log.info("store close on kill", node=name, error=str(e)[:120])
+        node.alive = False
+        log.info("node killed", node=name, seed=self.seed)
+        return node
+
+    def restart(self, name: str) -> TestnetNode:
+        """Rebuild a killed node from its kept KV store through the
+        production ClientBuilder resume path: the anchor watermark picks
+        the finalized state, surviving hot blocks re-import to rebuild
+        fork choice, and the persistent backfill watermark means sync
+        resumes where it stopped. The TestnetNode object (and its
+        _mesh_edges index) is reused — only `client` is replaced."""
+        node = self.node(name)
+        if node.alive:
+            raise ScenarioFailure(f"[seed={self.seed}] {name} is alive")
+        inc_counter("testnet_fault_injections_total", kind="restart")
+        node.client = self._build_client(name, **self._boot_kwargs[name])
+        node.alive = True
+        live = [n for n in self.live_nodes if n.name != name]
+        if live:
+            # rejoin fleet time before re-dialing: Status handshakes
+            # compare heads against the clock
+            node.client.slot_clock.set_slot(
+                max(int(n.client.slot_clock.now()) for n in live)
+            )
+        self._reconnect_mesh()
+        log.info("node restarted", node=name, seed=self.seed)
+        return node
+
+    def join(
+        self,
+        name: str,
+        *,
+        checkpoint_from: str,
+        vc_keypairs=None,
+        mesh_degree: int = 3,
+    ) -> TestnetNode:
+        """Boot a brand-new node into the LIVE fleet by checkpoint sync:
+        it fetches + verifies `checkpoint_from`'s finalized state over
+        that node's Beacon API, anchors there, wires into the mesh, and
+        serves the head forward while backfill fills history backward."""
+        inc_counter("testnet_fault_injections_total", kind="join")
+        peer = self.node(checkpoint_from)
+        url = f"http://127.0.0.1:{peer.client.http_server.port}"
+        base = dict(self._boot_kwargs[checkpoint_from])
+        base.update(
+            vc_keypairs=list(vc_keypairs) if vc_keypairs else [],
+            slasher=False,
+        )
+        node = self._boot_node(name, checkpoint_sync_url=url, **base)
+        node.client.slot_clock.set_slot(int(peer.client.slot_clock.now()))
+        # wire into the live mesh: the joiner's index is last, so every
+        # new edge keeps the (higher, lower) orientation _mesh_edges uses
+        idx = self.nodes.index(node)
+        targets = [
+            i for i, n in enumerate(self.nodes) if n.alive and i != idx
+        ]
+        if len(targets) > mesh_degree:
+            targets = sorted(self.rng.sample(targets, mesh_degree))
+        for j in targets:
+            self._mesh_edges.append((idx, j))
+            node.network.connect("127.0.0.1", self.nodes[j].network.port)
+        log.info(
+            "node joined via checkpoint sync",
+            node=name, source=checkpoint_from,
+            anchor_slot=int(node.chain.anchor_slot), seed=self.seed,
+        )
+        return node
 
     def eclipse(self, victim: str, liars: list[str], lie_extra_slots: int = 64):
         """Eclipse `victim`: dark to every honest fleet node; `liars`
@@ -868,7 +1017,7 @@ class Testnet:
     def _enforce_disconnects(self):
         """Sever live connections whose edge just went dark — a
         partition is connectivity loss, not polite silence."""
-        everyone = self.nodes + self.attackers
+        everyone = [n for n in self.nodes + self.attackers if n.alive]
         for a in everyone:
             for b in everyone:
                 if a is b or self.plane.dial_allowed(a.name, b.name):
@@ -881,6 +1030,8 @@ class Testnet:
     def _reconnect_mesh(self):
         for i, j in self._mesh_edges:
             a, b = self.nodes[i], self.nodes[j]
+            if not (a.alive and b.alive):
+                continue
             for attempt in range(3):
                 if self._connected(a, b.network.port):
                     break
@@ -963,6 +1114,7 @@ class ChainHealthOracle:
         max_finalized_distance: int | None = None,
         max_reorg_depth: int | None = None,
         max_rss_bytes: int | None = None,
+        max_hot_store_bytes: int | None = None,
         require_single_head: bool = False,
         zero_internal_errors: bool = True,
         what: str = "invariants",
@@ -971,7 +1123,7 @@ class ChainHealthOracle:
         the whole fleet); raises ScenarioFailure listing every violation
         with the scenario seed. Returns the per-node chain blocks so
         scenarios can report them."""
-        nodes = nodes if nodes is not None else self.net.nodes
+        nodes = nodes if nodes is not None else self.net.live_nodes
         failures = []
         blocks = []
         heads = set()
@@ -995,6 +1147,19 @@ class ChainHealthOracle:
                         f"{node.name}: serving-tier RSS {tier} > "
                         f"{max_rss_bytes} (process {data['rss_bytes']}, "
                         f"workers {tier - data['rss_bytes']})"
+                    )
+            if max_hot_store_bytes is not None:
+                # the bounded-store invariant: with the migrator running,
+                # the hot side holds only unfinalized data — a hot store
+                # past the budget means migration stalled or stopped
+                hot = data.get("store", {}).get("hot", {}).get(
+                    "total_bytes", 0
+                )
+                if hot > max_hot_store_bytes:
+                    failures.append(
+                        f"{node.name}: hot store {hot} bytes > "
+                        f"{max_hot_store_bytes} (split_slot "
+                        f"{data.get('store', {}).get('split_slot')})"
                     )
             heads.add(c["head_root"])
             if max_head_lag is not None and c["head_lag_slots"] > max_head_lag:
@@ -1138,6 +1303,121 @@ def _run_to_convergence(
         f"{ {n.name: n.chain.head_root.hex()[:8] for n in net.nodes} }, "
         f"finalized={_finalized_epochs(net)}, fin_at_heal={fin_at_heal})"
     )
+
+
+def run_churn_soak_scenario(
+    spec,
+    E,
+    *,
+    seed: int = 0,
+    node_count: int = 5,
+    churn_rounds: int = 3,
+    max_rss_bytes: int | None = None,
+) -> dict:
+    """Wall-clock-compressed fleet churn soak on a disk-backed testnet:
+    every round one node (~20% of the default fleet) is killed with its
+    KV store kept, the fleet runs an epoch without it, and it restarts
+    from disk and catches back up — while the oracle asserts finality
+    never stalls, heads reconverge, hot-store size stays bounded (the
+    migrator keeps moving finalized data cold through the churn), and the
+    serving tier's RSS stays under budget. Returns soak numbers for the
+    `testnet_churn_soak` bench."""
+    import shutil
+    import tempfile
+
+    db_dir = tempfile.mkdtemp(prefix="lighthouse_tpu_churn_")
+    net = Testnet.create(
+        spec,
+        E,
+        node_count=node_count,
+        validator_count=4 * node_count,
+        seed=seed,
+        db_dir=db_dir,
+    )
+    S = E.SLOTS_PER_EPOCH
+    t0 = time.perf_counter()
+    try:
+        oracle = ChainHealthOracle(net)
+
+        def hot_bytes() -> int:
+            return max(
+                oracle.health(n)
+                .get("store", {})
+                .get("hot", {})
+                .get("total_bytes", 0)
+                for n in net.live_nodes
+            )
+
+        def fin_min() -> int:
+            return min(
+                int(n.chain.finalized_checkpoint.epoch)
+                for n in net.live_nodes
+            )
+
+        def run_until_finality(start: int, target: int, what: str) -> int:
+            """Drive slots until every live node finalizes >= target AND
+            shares one head (bounded by 6 epochs — finality takes ~4
+            epochs of runway from a standing start)."""
+            slot = start
+            for slot in range(start, start + 6 * S):
+                net.run_slot(slot)
+                heads = {n.chain.head_root for n in net.live_nodes}
+                if len(heads) == 1 and fin_min() >= target:
+                    return slot
+            raise ScenarioFailure(
+                f"[seed={net.seed}] {what}: finality stalled at "
+                f"{fin_min()} (target {target}) by slot {slot}"
+            )
+
+        slot = run_until_finality(1, 1, "churn warmup")
+        oracle.check(
+            min_participation=0.9,
+            require_single_head=True,
+            min_finalized_epoch=1,
+            what="churn baseline",
+        )
+        # the post-finality hot footprint: with the migrator on, churn
+        # must not grow it past a small multiple of this
+        baseline_hot = hot_bytes()
+        hot_sizes = [baseline_hot]
+        for round_i in range(churn_rounds):
+            victim = net.rng.choice(net.live_nodes).name
+            fin_before = fin_min()
+            net.kill(victim)
+            # one epoch without the victim: 80% of stake keeps attesting
+            net.run_until_slot(slot + S, start_slot=slot + 1)
+            slot += S
+            net.restart(victim)
+            net.settle(timeout=10.0)
+            # drive until the restarted node is back on the single head
+            # and finality moved past the pre-kill point
+            slot = run_until_finality(
+                slot + 1, fin_before + 1, f"churn round {round_i}"
+            )
+            oracle.check(
+                require_single_head=True,
+                min_finalized_epoch=fin_before + 1,
+                max_hot_store_bytes=4 * max(baseline_hot, 1),
+                max_rss_bytes=max_rss_bytes,
+                what=f"churn round {round_i} ({victim})",
+            )
+            hot_sizes.append(hot_bytes())
+        wall_s = time.perf_counter() - t0
+        fin_final = fin_min()
+        return {
+            "seed": net.seed,
+            "wall_s": round(wall_s, 3),
+            "churn_rounds": churn_rounds,
+            "finalized_epoch_min": fin_final,
+            "finalized_slots_per_wall_s": round(fin_final * S / wall_s, 3),
+            "hot_store_bytes": hot_sizes,
+            "hot_store_growth": round(
+                hot_sizes[-1] / max(baseline_hot, 1), 3
+            ),
+        }
+    finally:
+        net.shutdown()
+        shutil.rmtree(db_dir, ignore_errors=True)
 
 
 def run_partition_heal_scenario(

@@ -75,6 +75,12 @@ def pytest_sessionstart(session):
     import lighthouse_tpu.validator_client  # noqa: F401 — registers vc_*
     # counters + vc_duty_cycle stage spans (bls_sign_batch_total comes
     # with the crypto.bls import above)
+    from lighthouse_tpu.store import (  # noqa: F401 — registers store_*
+        migrator,  # migration/reconstruction counters + prune spans
+    )
+    from lighthouse_tpu.beacon_chain import (  # noqa: F401 — registers
+        checkpoint_sync,  # boot counter + anchor-slot gauge
+    )
 
     text = REGISTRY.expose()
     for needle in (
@@ -347,6 +353,21 @@ def pytest_sessionstart(session):
         "trace_span_seconds_vc_protect",
         "trace_span_seconds_vc_sign_batch",
         "trace_span_seconds_vc_publish",
+        # PR 20: the storage lifecycle subsystem — the store_soak bench
+        # differences the migration counters ON-vs-OFF, the health block
+        # mirrors store_split_slot, and the checkpoint_boot_s bench reads
+        # the boot counter eagerly (the MIGRATE_STORE queue-wait series
+        # is covered by the WorkType loop above)
+        "store_migrations_total",
+        "store_blocks_migrated_total",
+        "store_cold_snapshots_total",
+        "store_states_reconstructed_total",
+        "store_da_entries_pruned_total",
+        "store_split_slot",
+        "checkpoint_sync_boots_total",
+        "checkpoint_sync_anchor_slot",
+        "trace_span_seconds_store_prune",
+        "trace_span_seconds_store_reconstruct",
     ):
         assert needle in text, (
             f"metric series {needle} missing from metrics exposition"

@@ -464,7 +464,12 @@ def test_slasher_process_rides_its_own_worktype_lane():
     from lighthouse_tpu.slasher.service import SlasherService
     from lighthouse_tpu.types.chain_spec import minimal_spec
 
-    assert WorkType.SLASHER_PROCESS == max(WorkType), "must be lowest priority"
+    # lowest-priority DUTY lane: only the store-migration housekeeping
+    # lane (PR 20) sits below it — detection must not wait on pruning
+    assert WorkType.SLASHER_PROCESS == max(
+        t for t in WorkType if t is not WorkType.MIGRATE_STORE
+    ), "must be lowest priority bar the migration housekeeping lane"
+    assert WorkType.MIGRATE_STORE == max(WorkType)
 
     bls.set_backend("fake_crypto")
     spec = replace(minimal_spec(), altair_fork_epoch=0)

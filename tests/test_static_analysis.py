@@ -290,6 +290,67 @@ def test_safe_arith_vc_slot_vocab_scoped_to_validator_client():
     assert lint_source(outside, BC) == []
 
 
+# a synthetic path inside store/ — in the safe-arith scope since the
+# lifecycle subsystem (PR 20: the migration cycle's finalized-boundary
+# and DA-cutoff slot math is uint64 arithmetic; the reference uses
+# saturating subtraction exactly where a raw `-` would underflow)
+ST = "lighthouse_tpu/store/_fixture.py"
+
+
+def test_safe_arith_fires_on_store_da_cutoff_arithmetic():
+    bad = (
+        "def f(chain, epoch, E):\n"
+        "    finalized_slot = compute_start_slot_at_epoch(epoch, E)\n"
+        "    return finalized_slot - chain.da_window_slots()\n"
+    )
+    assert _rules(lint_source(bad, ST)) == ["safe-arith"]
+
+
+def test_safe_arith_fires_on_store_window_producer_taint():
+    bad = (
+        "def f(chain, finalized_slot):\n"
+        "    window = chain.da_window_slots()\n"
+        "    return finalized_slot + window\n"
+    )
+    assert _rules(lint_source(bad, ST)) == ["safe-arith"]
+
+
+def test_safe_arith_store_clean_when_routed_through_helpers():
+    # the migrator's actual shape: the cutoff rides saturating_sub and
+    # restore-point spacing is a modulo (never flagged)
+    good = (
+        "from lighthouse_tpu.utils.safe_arith import saturating_sub\n"
+        "def f(chain, epoch, E, spacing):\n"
+        "    finalized_slot = compute_start_slot_at_epoch(epoch, E)\n"
+        "    cutoff = saturating_sub(finalized_slot, chain.da_window_slots())\n"
+        "    return cutoff % spacing\n"
+    )
+    assert lint_source(good, ST) == []
+
+
+def test_safe_arith_store_vocab_scoped_to_store():
+    # `da_window_slots` taints inside store/ only; the same snippet is
+    # clean at an out-of-scope path (compute_start_slot_at_epoch stays
+    # VC/store-scoped too — http_api callers do presentation math on it)
+    outside = (
+        "def f(chain, finalized_slot):\n"
+        "    return finalized_slot - chain.da_window_slots()\n"
+    )
+    assert lint_source(outside, OUT) == []
+
+
+def test_safe_arith_store_epoch_claim_bookkeeping_stays_clean():
+    # the migrator's atomic epoch claim decrements a plain Python int on
+    # unclaim — deliberately OUT of the vocab (`.epoch` attrs untainted
+    # in store/), so the claim/unclaim pattern lints clean
+    good = (
+        "def unclaim(self, epoch):\n"
+        "    if self._last_migrated_epoch == epoch:\n"
+        "        self._last_migrated_epoch = epoch - 1\n"
+    )
+    assert lint_source(good, ST) == []
+
+
 def test_fork_safety_fires_on_das_shaped_worker():
     # das/proofs.py keeps its pool workers (_msm_shard/_prove_shard)
     # metrics-free for exactly this rule: counters are parent-side only

@@ -34,7 +34,9 @@ from lighthouse_tpu.testing.testnet import (
     ChainHealthOracle,
     DasTestnetEthSpec,
     FaultPlane,
+    ScenarioFailure,
     Testnet,
+    run_churn_soak_scenario,
     run_column_withholding_scenario,
     run_eclipse_scenario,
     run_equivocation_scenario,
@@ -471,6 +473,108 @@ def test_lookup_rotation_spans_whole_pool_past_empty_answers():
     assert got.message.hash_tree_root() == head_root
 
 
+# -- storage lifecycle verbs (kill / restart / join) ---------------------------
+
+
+def _drive_to_finality(net, start: int, target: int) -> int:
+    """Run slots until every LIVE node shares one head and finalizes
+    >= target (finality needs ~4 epochs of runway from a standing
+    start). Returns the last slot driven."""
+    S = E.SLOTS_PER_EPOCH
+    slot = start
+    for slot in range(start, start + 6 * S):
+        net.run_slot(slot)
+        heads = {n.chain.head_root for n in net.live_nodes}
+        fins = [
+            int(n.chain.finalized_checkpoint.epoch) for n in net.live_nodes
+        ]
+        if len(heads) == 1 and min(fins) >= target:
+            return slot
+    raise AssertionError(
+        f"no finality >= {target} within 6 epochs (got {fins})"
+    )
+
+
+def test_kill_restart_needs_disk_backed_fleet():
+    net = Testnet.create(_spec(), E, node_count=2, validator_count=8, seed=3)
+    try:
+        with pytest.raises(ScenarioFailure, match="disk-backed"):
+            net.kill("node0")
+    finally:
+        net.shutdown()
+
+
+def test_kill_restart_node_resumes_from_store(tmp_path):
+    """The kill→restart cycle at its smallest shape: a 3-node disk-backed
+    fleet finalizes, one node dies (store kept), the fleet keeps going,
+    and the restarted node rebuilds from its KV store and reconverges —
+    while finality never stalls."""
+    S = E.SLOTS_PER_EPOCH
+    net = Testnet.create(
+        _spec(), E, node_count=3, validator_count=12, seed=7,
+        db_dir=str(tmp_path),
+    )
+    try:
+        oracle = ChainHealthOracle(net)
+        slot = _drive_to_finality(net, start=1, target=1)
+        oracle.check(
+            require_single_head=True, min_finalized_epoch=1,
+            what="pre-kill baseline",
+        )
+        fin_before = min(
+            int(n.chain.finalized_checkpoint.epoch) for n in net.nodes
+        )
+        victim = net.kill("node2")
+        assert not victim.alive
+        assert len(net.live_nodes) == 2
+        # the fleet runs an epoch without the victim
+        net.run_until_slot(slot + S, start_slot=slot + 1)
+        slot += S
+        net.restart("node2")
+        assert victim.alive and victim.client is not None
+        # the restarted chain resumed from the anchor watermark, not genesis
+        assert victim.chain.anchor_slot >= S
+        net.settle(timeout=10.0)
+        _drive_to_finality(net, start=slot + 1, target=fin_before + 1)
+        oracle.check(
+            require_single_head=True, min_finalized_epoch=fin_before + 1,
+            what="post-restart",
+        )
+    finally:
+        net.shutdown()
+
+
+def test_join_node_checkpoint_syncs_into_live_fleet(tmp_path):
+    """A brand-new node joins a running fleet by checkpoint sync off a
+    peer's Beacon API: it anchors on the peer's finalized state (NOT
+    genesis), follows the head forward, and serves its own health."""
+    S = E.SLOTS_PER_EPOCH
+    net = Testnet.create(
+        _spec(), E, node_count=3, validator_count=12, seed=13,
+        db_dir=str(tmp_path),
+    )
+    try:
+        slot = _drive_to_finality(net, start=1, target=1)
+        joiner = net.join("node3", checkpoint_from="node0")
+        assert joiner.alive
+        assert len(net.live_nodes) == 4
+        # anchored on finality, history absent below the anchor
+        assert joiner.chain.anchor_slot >= S
+        assert REGISTRY.counter("checkpoint_sync_boots_total").value() >= 1
+        net.settle(timeout=10.0)
+        net.run_until_slot(slot + S, start_slot=slot + 1)
+        net.wait_for(
+            lambda: joiner.chain.head_root
+            == net.node("node0").chain.head_root,
+            timeout=20.0, what="joiner follows the live head",
+        )
+        oracle = ChainHealthOracle(net)
+        c = oracle.chain_block(joiner)
+        assert c["head_slot"] >= slot
+    finally:
+        net.shutdown()
+
+
 # -- full-fleet scenarios (slow) -----------------------------------------------
 
 
@@ -504,6 +608,20 @@ def test_gossip_flood_sheds_and_finalizes():
     assert report["flood_sent"] > 0
     assert any(v > 0 for v in report["shed"].values())
     assert min(report["finalized"]) >= 1
+
+
+@pytest.mark.slow
+def test_churn_soak_fleet_keeps_finalizing_with_bounded_stores():
+    """The churn regime: every round ~20% of the fleet dies and restarts
+    from disk while the oracle asserts finality never stalls, heads
+    reconverge, and the migrator keeps the hot stores bounded."""
+    report = run_churn_soak_scenario(_spec(), E, churn_rounds=2)
+    assert report["churn_rounds"] == 2
+    assert report["finalized_epoch_min"] >= 3
+    assert report["finalized_slots_per_wall_s"] > 0
+    # bounded hot store: growth over the whole churn stays under the
+    # oracle's 4x budget (the per-round check already enforced it live)
+    assert report["hot_store_growth"] <= 4.0
 
 
 @pytest.mark.slow
